@@ -1,0 +1,66 @@
+"""Ballot encoding/bumping vs the reference rules
+(ref multi/paxos.cpp:792-799: ballot = (count<<16)|index, bumped past
+max seen)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.core import ballot as bal
+
+
+def test_encode_decode_roundtrip():
+    for count, node in [(1, 0), (1, 5), (7, 65535), (32000, 3)]:
+        b = bal.make(count, node)
+        assert int(bal.count_of(b)) == count
+        assert int(bal.node_of(b)) == node
+
+
+def test_ordering_count_dominates_node():
+    # (2, 0) > (1, 65535): count is the high-order field.
+    assert int(bal.make(2, 0)) > int(bal.make(1, 65535))
+    # Same count: node breaks ties.
+    assert int(bal.make(3, 4)) > int(bal.make(3, 2))
+
+
+def test_bump_past_simple():
+    count, b = bal.bump_past(0, 2, 0)
+    assert int(count) == 1
+    assert int(b) == int(bal.make(1, 2))
+
+
+def test_bump_past_exceeds_max_seen():
+    # Seen ballot (5, 7); node 2 must reach count 6 to beat it
+    # (count 5, node 2 < count 5, node 7).
+    seen = bal.make(5, 7)
+    count, b = bal.bump_past(0, 2, seen)
+    assert int(b) > int(seen)
+    assert int(bal.node_of(b)) == 2
+    assert int(count) == 6
+
+
+def test_bump_past_same_count_higher_node_ok():
+    # Seen (5, 1); node 2's count-5 ballot already beats it, but count
+    # must still advance past our own previous count.
+    seen = bal.make(5, 1)
+    count, b = bal.bump_past(4, 2, seen)
+    assert int(b) > int(seen)
+    assert int(count) == 5
+
+
+def test_bump_past_monotone_self():
+    # Repeated bumps strictly increase even with max_seen = 0.
+    count = jnp.int32(0)
+    prev = 0
+    for _ in range(5):
+        count, b = bal.bump_past(count, 3, 0)
+        assert int(b) > prev
+        prev = int(b)
+
+
+def test_bump_past_vectorized():
+    counts = jnp.array([0, 4, 9], jnp.int32)
+    nodes = jnp.array([0, 1, 2], jnp.int32)
+    seen = jnp.array([int(bal.make(5, 7)), 0, int(bal.make(9, 9))], jnp.int32)
+    new_counts, bs = bal.bump_past(counts, nodes, seen)
+    assert np.all(np.asarray(bs) > np.asarray(seen))
+    assert np.all(np.asarray(new_counts) > np.asarray(counts))

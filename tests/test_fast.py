@@ -1,0 +1,130 @@
+"""Fast fault-free engine: phase semantics + whole-run invariants.
+
+Semantics under test mirror the reference acceptor/proposer rules:
+strict-> promise (multi/paxos.cpp:865), >= accept (1366), max-ballot
+adoption (1201-1223), quorum n//2+1 (1047).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.core import apply as apl
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import fast
+from tpu_paxos.core import values as val
+from tpu_paxos.harness import validate
+
+
+def test_choose_all_basic():
+    n_inst, n_nodes = 100, 3
+    state = fast.init_state(n_inst, n_nodes)
+    vids = jnp.arange(n_inst, dtype=jnp.int32)
+    state, n_chosen = fast.choose_all(state, vids, proposer=0, quorum=2)
+    assert int(n_chosen) == n_inst
+    learned = np.asarray(state.learned)
+    validate.check_all(learned, expected_vids=np.arange(n_inst))
+    # Every node learned every instance; frontier = I everywhere.
+    assert np.asarray(apl.frontiers(state.learned)).tolist() == [n_inst] * n_nodes
+
+
+def test_promise_is_strict():
+    state = fast.init_state(4, 3)
+    b = bal.make(1, 0)
+    state, prepared, _, _ = fast.phase1_prepare(state, b, quorum=2)
+    assert bool(prepared)
+    # Same ballot again: no acceptor promises (strict >), quorum fails.
+    _, prepared2, _, _ = fast.phase1_prepare(state, b, quorum=2)
+    assert not bool(prepared2)
+
+
+def test_accept_is_geq():
+    state = fast.init_state(4, 3)
+    b = bal.make(1, 0)
+    state, _, _, _ = fast.phase1_prepare(state, b, quorum=2)
+    # Accept with the same promised ballot succeeds (>=).
+    vids = jnp.arange(4, dtype=jnp.int32)
+    state, chosen = fast.phase2_accept(state, b, vids, quorum=2)
+    assert bool(chosen)
+    # Lower ballot is rejected by all.
+    lower = bal.make(0, 5)
+    _, chosen2 = fast.phase2_accept(state, lower, vids, quorum=2)
+    assert not bool(chosen2)
+
+
+def test_adoption_max_ballot_wins():
+    n_inst, n_nodes = 3, 3
+    state = fast.init_state(n_inst, n_nodes)
+    # Acceptor 0 accepted vid 7 at ballot (1,0); acceptor 1 accepted
+    # vid 9 at the higher ballot (2,1) for instance 0.
+    acc_ballot = np.full((n_inst, n_nodes), int(bal.NONE), np.int32)
+    acc_vid = np.full((n_inst, n_nodes), int(val.NONE), np.int32)
+    acc_ballot[0, 0], acc_vid[0, 0] = int(bal.make(1, 0)), 7
+    acc_ballot[0, 1], acc_vid[0, 1] = int(bal.make(2, 1)), 9
+    state = state._replace(
+        acc_ballot=jnp.asarray(acc_ballot), acc_vid=jnp.asarray(acc_vid)
+    )
+    b = bal.make(3, 2)
+    _, prepared, adopted_ballot, adopted_vid = fast.phase1_prepare(
+        state, b, quorum=2
+    )
+    assert bool(prepared)
+    assert int(adopted_vid[0]) == 9  # max accepted ballot wins
+    assert int(adopted_ballot[0]) == int(bal.make(2, 1))
+    assert int(adopted_vid[1]) == int(val.NONE)
+
+
+def test_choose_all_respects_preaccepted():
+    # A value pre-accepted by one acceptor must be re-proposed by the
+    # new proposer for that instance, not overwritten by its own value.
+    n_inst, n_nodes = 5, 3
+    state = fast.init_state(n_inst, n_nodes)
+    acc_ballot = np.full((n_inst, n_nodes), int(bal.NONE), np.int32)
+    acc_vid = np.full((n_inst, n_nodes), int(val.NONE), np.int32)
+    acc_ballot[2, 1], acc_vid[2, 1] = int(bal.make(1, 1)), 777
+    state = state._replace(
+        acc_ballot=jnp.asarray(acc_ballot), acc_vid=jnp.asarray(acc_vid)
+    )
+    vids = jnp.arange(n_inst, dtype=jnp.int32)
+    state, n_chosen = fast.choose_all(state, vids, proposer=0, quorum=2)
+    assert int(n_chosen) == n_inst
+    learned = np.asarray(state.learned)
+    assert (learned[2] == 777).all()
+    validate.check_agreement(learned)
+
+
+def test_holes_leave_none():
+    # Instances with no value (vid NONE) stay unchosen.
+    state = fast.init_state(6, 3)
+    vids = np.arange(6, dtype=np.int32)
+    vids[3] = int(val.NONE)
+    state, n_chosen = fast.choose_all(
+        state, jnp.asarray(vids), proposer=0, quorum=2
+    )
+    assert int(n_chosen) == 5  # all but the hole chosen
+    learned = np.asarray(state.learned)
+    assert (learned[3] == int(val.NONE)).all()
+    # Frontier stops at the hole.
+    assert np.asarray(apl.frontiers(state.learned)).tolist() == [3, 3, 3]
+
+
+def test_validate_catches_disagreement():
+    learned = np.zeros((4, 3), np.int32)
+    learned[:, :] = np.arange(4)[:, None]
+    learned[2, 1] = 99
+    try:
+        validate.check_agreement(learned)
+    except validate.InvariantViolation:
+        pass
+    else:
+        raise AssertionError("disagreement not caught")
+
+
+def test_validate_catches_duplicate():
+    learned = np.zeros((4, 3), np.int32)
+    learned[:, :] = np.array([0, 1, 1, 3])[:, None]
+    try:
+        validate.check_exactly_once(learned)
+    except validate.InvariantViolation:
+        pass
+    else:
+        raise AssertionError("duplicate not caught")

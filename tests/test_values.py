@@ -1,0 +1,58 @@
+"""Value-id interning and no-op encoding (SURVEY component 14)."""
+
+import numpy as np
+
+from tpu_paxos.core import values as val
+
+
+def test_real_vid_roundtrip():
+    stride = 1 << 20
+    v = val.real_vid(3, 12345, stride)
+    assert int(val.real_proposer_of(v, stride)) == 3
+    assert int(val.real_seq_of(v, stride)) == 12345
+    assert not bool(val.is_noop(v))
+    assert not bool(val.is_none(v))
+
+
+def test_noop_vid_distinct_and_decodable():
+    n_inst = 1000
+    seen = set()
+    for p in range(3):
+        for i in (0, 1, 999):
+            v = int(val.noop_vid(i, p, n_inst))
+            assert v <= val.NOOP_BASE
+            assert bool(val.is_noop(v))
+            seen.add(v)
+            pp, ii = val.noop_decode(v, n_inst)
+            assert (int(pp), int(ii)) == (p, i)
+    assert len(seen) == 9
+
+
+def test_decode_host_matches_device_encoding():
+    stride, n_inst = 1 << 20, 777
+    p, s, noop = val.decode_host(int(val.real_vid(2, 42, stride)), stride, n_inst)
+    assert (p, s, noop) == (2, 42, False)
+    p, i, noop = val.decode_host(int(val.noop_vid(5, 1, n_inst)), stride, n_inst)
+    assert (p, i, noop) == (1, 5, True)
+
+
+def test_decode_host_array():
+    stride, n_inst = 100, 50
+    vids = np.array(
+        [int(val.real_vid(1, 7, stride)), int(val.noop_vid(3, 2, n_inst)), 0]
+    )
+    p, v, noop = val.decode_host_array(vids, stride, n_inst)
+    assert p.tolist() == [1, 2, 0]
+    assert v.tolist() == [7, 3, 0]
+    assert noop.tolist() == [False, True, False]
+
+
+def test_intern_table():
+    t = val.InternTable()
+    a = t.intern(b"hello")
+    b = t.intern("hello")
+    c = t.intern(b"world")
+    assert a == b == 0
+    assert c == 1
+    assert t.payload(0) == b"hello"
+    assert len(t) == 2

@@ -1,0 +1,45 @@
+"""tpu_paxos — a TPU-native multi-Paxos framework.
+
+A from-scratch reimplementation of the capabilities of the reference
+C++ multi-Paxos verifier (yuchenkan/multi-paxos), re-designed for TPU
+hardware: per-instance consensus state lives in SoA arrays of shape
+``[instances, nodes]``, the protocol runs as a bulk-synchronous round
+function (pure JAX under ``jit``/``vmap``/``lax.scan``), communication
+is node-axis reductions, cross-chip scale-out shards the instance axis
+with ``shard_map`` + ``psum`` over ICI, and all asynchrony (network
+drop/dup/delay, retries, dueling-proposer backoff, crashes) is
+expressed as per-round masks and counters driven by ``jax.random``.
+
+Layer map (mirrors SURVEY.md §1 for the reference):
+
+- L0 primitives:   ``utils/`` (PRNG streams, round counters, logging)
+- L1 determinism:  ``replay/`` (seeded replay, decision logs)
+- L2 embedder SPI: ``config.py`` + harness seams (workload, network
+  fault model, state-machine apply hooks)
+- L3 protocol:     ``core/`` (acceptor/proposer/learner round fns)
+- L4 value model:  ``core/values.py`` (interned int32 value ids)
+- L5 harness:      ``harness/`` (simulators, validation, CLI)
+- scale-out:       ``parallel/`` (mesh, shard_map round loops)
+- membership:      ``membership/`` (member/ parity: role masks,
+  versions, reconfiguration)
+- native runtime:  ``native/`` (C++ decision-log codec + invariant
+  checker, loaded via ctypes)
+"""
+
+from tpu_paxos.config import (
+    FaultConfig,
+    ProtocolConfig,
+    SimConfig,
+)
+from tpu_paxos.core import ballot, values
+
+__version__ = "0.2.0"
+
+__all__ = [
+    "ProtocolConfig",
+    "FaultConfig",
+    "SimConfig",
+    "ballot",
+    "values",
+    "__version__",
+]

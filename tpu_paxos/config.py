@@ -1,0 +1,140 @@
+"""Configuration dataclasses — the TPU equivalent of the reference SPI knobs.
+
+The reference exposes retry/timeout knobs through ``paxos::Config``
+(ref multi/paxos.h:251-274: prepare_delay_min/max, prepare_retry_count,
+prepare_retry_timeout, accept_retry_count, accept_retry_timeout,
+commit_retry_timeout) and fault-injection knobs through the harness CLI
+(ref multi/main.cpp:467-496: --net-drop-rate, --net-dup-rate,
+--net-min-delay, --net-max-delay, --seed).
+
+Here wall-clock milliseconds become integer *rounds* of the
+bulk-synchronous schedule: one round is one full message exchange
+(request leg + reply leg).  A retry timeout of ``k`` means "if the
+quorum has not been reached ``k`` rounds after sending, resend".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolConfig:
+    """Protocol liveness knobs, in units of rounds.
+
+    Mirrors ``paxos::Config`` (ref multi/paxos.h:251-274) with
+    milliseconds mapped to round counts.
+    """
+
+    # Randomized delay before (re)starting a prepare — the anti-dueling
+    # backoff (ref multi/paxos.cpp:1244-1247 samples uniformly in
+    # [prepare_delay_min_, prepare_delay_max_]).
+    prepare_delay_min: int = 0
+    prepare_delay_max: int = 4
+    # Prepare is resent this many times, prepare_retry_timeout rounds
+    # apart, before restarting with a higher ballot
+    # (ref multi/paxos.cpp:757-801).
+    prepare_retry_count: int = 3
+    prepare_retry_timeout: int = 2
+    # Accept is resent this many times before falling back to prepare
+    # (AcceptRejected, ref multi/paxos.cpp:969-983, 1328-1343).
+    accept_retry_count: int = 3
+    accept_retry_timeout: int = 2
+    # Commit/learn is retried forever, this many rounds apart, until
+    # every node has replied (ref multi/paxos.cpp:1022-1027, 1625-1641).
+    commit_retry_timeout: int = 2
+
+    def __post_init__(self) -> None:
+        if self.prepare_delay_min < 0:
+            raise ValueError("prepare_delay_min must be >= 0")
+        if self.prepare_delay_min > self.prepare_delay_max:
+            raise ValueError("prepare_delay_min > prepare_delay_max")
+        for name in (
+            "prepare_retry_count",
+            "prepare_retry_timeout",
+            "accept_retry_count",
+            "accept_retry_timeout",
+            "commit_retry_timeout",
+        ):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Network fault injection, THNetWork semantics.
+
+    The reference drops a message with probability drop_rate/10000,
+    duplicates with dup_rate/10000 (up to 3 copies, recursively), and
+    delays by a uniform sample in [min_delay, max_delay] milliseconds
+    (ref multi/main.cpp:51-162).  Here delays are integer rounds; a
+    dropped message simply never arrives (the protocol's retry ladder
+    provides liveness), and duplicates are re-deliveries of idempotent
+    messages (they additionally improve effective delivery probability,
+    which is how they are modelled: an edge delivers if any of its
+    1 + dup copies survives the drop coin).
+    """
+
+    drop_rate: int = 0  # per 10_000
+    dup_rate: int = 0  # per 10_000
+    min_delay: int = 0  # rounds
+    max_delay: int = 0  # rounds
+    # member/ style random process crashes: probability per node per
+    # round, per 1_000_000 (ref member/indet.h:146-150 crashes with
+    # failure_rate/1e6 on every log call).
+    crash_rate: int = 0  # per 1_000_000
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.drop_rate <= 10_000:
+            raise ValueError("drop_rate must be in [0, 10000]")
+        if not 0 <= self.dup_rate <= 10_000:
+            raise ValueError("dup_rate must be in [0, 10000]")
+        if self.min_delay > self.max_delay:
+            raise ValueError("min_delay > max_delay")
+        if self.min_delay < 0:
+            raise ValueError("min_delay must be >= 0")
+        if not 0 <= self.crash_rate <= 1_000_000:
+            raise ValueError("crash_rate must be in [0, 1000000]")
+
+    @property
+    def is_reliable(self) -> bool:
+        return (
+            self.drop_rate == 0
+            and self.min_delay == 0
+            and self.max_delay == 0
+            and self.crash_rate == 0
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Whole-simulation shape: the TPU analog of the reference CLI line
+    ``srvcnt cltcnt idcnt propose_interval --seed=...``
+    (ref multi/main.cpp:456-521, multi/debug.conf.sample:1)."""
+
+    n_nodes: int = 3
+    n_instances: int = 100
+    # Which nodes act as proposers.  () means node 0 only.
+    proposers: tuple[int, ...] = (0,)
+    seed: int = 0
+    # Hard cap on simulated rounds (liveness watchdog, not a protocol
+    # knob).  The scan exits early once every instance is chosen.
+    max_rounds: int = 10_000
+    protocol: ProtocolConfig = dataclasses.field(default_factory=ProtocolConfig)
+    faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+
+    def __post_init__(self) -> None:
+        if self.n_nodes < 1:
+            raise ValueError("n_nodes must be >= 1")
+        if self.n_instances < 1:
+            raise ValueError("n_instances must be >= 1")
+        props = self.proposers or (0,)
+        object.__setattr__(self, "proposers", tuple(sorted(set(props))))
+        for p in self.proposers:
+            if not 0 <= p < self.n_nodes:
+                raise ValueError(f"proposer {p} out of range")
+
+    @property
+    def quorum(self) -> int:
+        # Majority quorum, ref multi/paxos.cpp:1047: n/2 + 1.
+        return self.n_nodes // 2 + 1

@@ -1,0 +1,3 @@
+"""Protocol core: ballots, values, acceptor/proposer/learner round functions."""
+
+from tpu_paxos.core import ballot, values  # noqa: F401

@@ -1,0 +1,46 @@
+"""Learner execution frontier — in-order apply via prefix scans.
+
+The reference learner walks ``next_id_to_apply_`` forward while the
+next instance is committed, executing non-no-op values in instance
+order (ref multi/paxos.cpp:1584-1620; member/paxos.cpp:1029-1060).
+On TPU the frontier is a prefix reduction: an instance is *applicable*
+when every instance at or below it is learned, so the frontier is the
+length of the leading all-learned prefix, computed with ``cumprod`` /
+``cummin`` instead of a sequential walk.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.core import values as val
+
+
+def frontier(learned_col) -> jnp.ndarray:
+    """Index of the first unlearned instance for one node's learner
+    state ``learned_col`` [I] (vid or NONE) — everything below it is
+    applicable, matching the reference's next_id_to_apply_ walk."""
+    known = (jnp.asarray(learned_col) != val.NONE).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(known))
+
+
+def frontiers(learned) -> jnp.ndarray:
+    """Per-node frontiers for learned [I, A]."""
+    known = (jnp.asarray(learned) != val.NONE).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(known, axis=0), axis=0)
+
+
+def executed_sequence(learned_col: np.ndarray) -> np.ndarray:
+    """Host-side: the sequence of non-no-op vids a node's state machine
+    executes, in instance order up to the frontier (the reference skips
+    no-ops at ref multi/paxos.cpp:1598-1599)."""
+    learned_col = np.asarray(learned_col)
+    known = learned_col != int(val.NONE)
+    f = int(np.cumprod(known.astype(np.int64)).sum())
+    prefix = learned_col[:f]
+    return prefix[prefix >= 0]  # drop no-ops (vid <= -2); NONE can't appear
+
+
+def executed_sequences(learned: np.ndarray) -> list[np.ndarray]:
+    return [executed_sequence(learned[:, a]) for a in range(learned.shape[1])]

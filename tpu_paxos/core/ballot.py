@@ -1,0 +1,54 @@
+"""Ballot (proposal id) encoding and bumping.
+
+The reference encodes a ballot as ``(count << 16) | node_index`` and,
+when (re)starting a prepare, bumps ``count`` until the ballot exceeds
+the largest ballot ever seen (ref multi/paxos.cpp:792-799
+``UpdateProposalID``; member/paxos.cpp:1569-1574 is identical).  The
+node index in the low bits makes ballots globally unique and totally
+ordered, with ties between counts broken by node id.
+
+Everything here is pure int32 arithmetic, safe under ``jit``/``vmap``.
+int32 bounds the retry count at 2**15 restarts per proposer, far above
+anything the liveness ladder produces.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NODE_BITS = 16
+NONE = jnp.int32(-1)  # "no ballot" sentinel (valid ballots are > 0)
+
+
+def make(count, node):
+    """Ballot from (count, node): ``(count << 16) | node``."""
+    count = jnp.asarray(count, jnp.int32)
+    node = jnp.asarray(node, jnp.int32)
+    return (count << NODE_BITS) | node
+
+
+def count_of(b):
+    return jnp.asarray(b, jnp.int32) >> NODE_BITS
+
+
+def node_of(b):
+    return jnp.asarray(b, jnp.int32) & ((1 << NODE_BITS) - 1)
+
+
+def bump_past(count, node, max_seen):
+    """Smallest (new_count, ballot) with new_count > count and
+    ballot > max_seen — a closed form of the reference's
+    ``while (proposal_id_ < max_proposal_id_) ++proposal_count_`` loop
+    (ref multi/paxos.cpp:792-799), branch-free for jit.
+    """
+    count = jnp.asarray(count, jnp.int32)
+    node = jnp.asarray(node, jnp.int32)
+    max_seen = jnp.asarray(max_seen, jnp.int32)
+    # The candidate must beat both the proposer's own count and the max
+    # ballot seen from peers / rejects.
+    floor_count = jnp.maximum(count + 1, count_of(max_seen))
+    cand = make(floor_count, node)
+    # If max_seen has the same count but a higher node index, one more
+    # count increment is needed.
+    new_count = jnp.where(cand > max_seen, floor_count, floor_count + 1)
+    return new_count, make(new_count, node)

@@ -1,0 +1,146 @@
+"""Fast path: fault-free batched multi-Paxos as fused array ops.
+
+This is the bulk-synchronous reframing of the reference's *batched*
+protocol flow for a single prepared proposer: one prepare covering
+every instance (interval-set prepare, ref multi/paxos.cpp:809-828), one
+batched accept (ref multi/paxos.cpp:1299-1326), one batched commit
+(ref multi/paxos.cpp:1446-1479).  With a reliable network each phase is
+one array op over the ``[instances, nodes]`` SoA state, so driving I
+instances to chosen is three fused elementwise/reduction kernels — this
+is the headline-benchmark path.
+
+Protocol semantics preserved exactly:
+- promise iff ballot strictly greater than promised
+  (ref multi/paxos.cpp:865), where ``promised`` is one scalar per
+  acceptor covering all instances (ref multi/paxos.cpp: single
+  ``promised_proposal_id_`` member);
+- prepare replies return pre-accepted values, adopted by max accepted
+  ballot (ref multi/paxos.cpp:1201-1223 ``UpdateByPreAcceptedValues``);
+- accept iff ballot >= promised (ref multi/paxos.cpp:1366);
+- quorum is n//2 + 1 (ref multi/paxos.cpp:1047);
+- chosen values are broadcast to every node (commit,
+  ref multi/paxos.cpp:1446-1479) and recorded in each node's learner
+  state.
+
+The fault-tolerant, multi-proposer, retrying engine lives in
+``core/sim.py``; this module trades generality for peak throughput.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import values as val
+
+
+class FastState(NamedTuple):
+    """SoA consensus state, shapes [I] / [A] / [I, A]."""
+
+    promised: jax.Array  # [A] int32  — per-acceptor promised ballot
+    max_seen: jax.Array  # [A] int32  — max ballot ever seen (for rejects)
+    acc_ballot: jax.Array  # [I, A] int32 — accepted ballot (-1 none)
+    acc_vid: jax.Array  # [I, A] int32 — accepted value id (-1 none)
+    learned: jax.Array  # [I, A] int32 — chosen vid known to node a (-1)
+
+
+def init_state(n_instances: int, n_nodes: int) -> FastState:
+    i, a = n_instances, n_nodes
+    return FastState(
+        promised=jnp.zeros((a,), jnp.int32),
+        max_seen=jnp.zeros((a,), jnp.int32),
+        acc_ballot=jnp.full((i, a), bal.NONE, jnp.int32),
+        acc_vid=jnp.full((i, a), val.NONE, jnp.int32),
+        learned=jnp.full((i, a), val.NONE, jnp.int32),
+    )
+
+
+def phase1_prepare(state: FastState, ballot: jax.Array, quorum: int):
+    """Broadcast prepare; collect promises + pre-accepted values.
+
+    Returns (state, prepared, adopted_ballot [I], adopted_vid [I]):
+    ``prepared`` is the quorum bool; adopted_* is the max-ballot
+    pre-accepted value per instance over promising acceptors (NONE
+    where no acceptor reported one).
+    """
+    promise = ballot > state.promised  # strict >, ref multi/paxos.cpp:865
+    promised = jnp.where(promise, ballot, state.promised)
+    max_seen = jnp.maximum(state.max_seen, ballot)
+    prepared = jnp.sum(promise.astype(jnp.int32)) >= quorum
+
+    # Adoption: among promising acceptors, take the value with the
+    # largest accepted ballot (ref multi/paxos.cpp:1201-1223).
+    rep_ballot = jnp.where(promise[None, :], state.acc_ballot, bal.NONE)
+    best = jnp.argmax(rep_ballot, axis=1)  # [I]
+    rows = jnp.arange(state.acc_vid.shape[0])
+    has = rep_ballot[rows, best] > 0
+    adopted_ballot = jnp.where(has, rep_ballot[rows, best], bal.NONE)
+    adopted_vid = jnp.where(has, state.acc_vid[rows, best], val.NONE)
+
+    return (
+        state._replace(promised=promised, max_seen=max_seen),
+        prepared,
+        adopted_ballot,
+        adopted_vid,
+    )
+
+
+def phase2_accept(state: FastState, ballot: jax.Array, vids: jax.Array, quorum: int):
+    """Broadcast one batched accept of ``vids`` [I]; count acks.
+
+    Returns (state, chosen [bool scalar]): the whole batch is accepted
+    or rejected per acceptor (the reference acceptor stores every value
+    in the batch iff ballot >= promised, ref multi/paxos.cpp:1359-1397),
+    so the quorum decision is per batch.
+    """
+    ok = ballot >= state.promised  # >=, ref multi/paxos.cpp:1366
+    max_seen = jnp.maximum(state.max_seen, ballot)
+    store = ok[None, :] & (vids != val.NONE)[:, None]
+    acc_ballot = jnp.where(store, ballot, state.acc_ballot)
+    acc_vid = jnp.where(store, vids[:, None], state.acc_vid)
+    chosen = jnp.sum(ok.astype(jnp.int32)) >= quorum
+    return state._replace(
+        max_seen=max_seen, acc_ballot=acc_ballot, acc_vid=acc_vid
+    ), chosen
+
+
+def phase3_learn(state: FastState, vids: jax.Array, chosen) -> FastState:
+    """Broadcast commit of chosen ``vids`` to every node's learner
+    (ref multi/paxos.cpp:1446-1518: committed_values_ insert)."""
+    mask = chosen & (vids != val.NONE)
+    learn = mask if jnp.ndim(mask) else jnp.broadcast_to(mask, vids.shape)
+    learned = jnp.where(learn[:, None], vids[:, None], state.learned)
+    return state._replace(learned=learned)
+
+
+def choose_all(
+    state: FastState, vids: jax.Array, proposer: int, quorum: int
+) -> tuple[FastState, jax.Array]:
+    """Drive every instance with a value to chosen: the fused
+    prepare → accept → commit pipeline of one prepared proposer.
+
+    Returns (state, n_chosen).  Under jit this compiles to a handful of
+    fused elementwise + reduce ops — the instances/sec headline number.
+    """
+    count, ballot = bal.bump_past(
+        jnp.int32(0), jnp.int32(proposer), jnp.max(state.max_seen)
+    )
+    del count
+    state, prepared, adopted_ballot, adopted_vid = phase1_prepare(
+        state, ballot, quorum
+    )
+    # Pre-accepted values win over our own proposals for their
+    # instances (ref multi/paxos.cpp:1078-1101).
+    use_adopted = adopted_ballot != bal.NONE
+    batch = jnp.where(use_adopted, adopted_vid, vids)
+    batch = jnp.where(prepared, batch, val.NONE)
+    state, chosen = phase2_accept(state, ballot, batch, quorum)
+    state = phase3_learn(state, batch, chosen)
+    n_chosen = jnp.sum((state.learned[:, 0] != val.NONE).astype(jnp.int32))
+    return state, n_chosen
+
+
+choose_all_jit = jax.jit(choose_all, static_argnames=("proposer", "quorum"))

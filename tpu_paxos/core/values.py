@@ -1,0 +1,123 @@
+"""Value model: interned int32 value ids (SoA — SURVEY.md component 14).
+
+The reference's ``Value`` carries (proposer, value_id, noop flag,
+payload-or-membership-change) and is compared field-wise
+(ref multi/paxos.cpp:185-223).  Variable-length payloads do not belong
+on a TPU, so the framework interns every distinct value to one int32
+``vid``; protocol state and messages carry only vids, and equality is
+integer equality.  Payload bytes (and membership-change descriptors)
+live host-side in the workload's intern table.
+
+vid space:
+- ``vid == -1``       : NONE (no value)
+- ``vid >= 0``        : real values, assigned by the workload; the
+  canonical harness assignment is ``vid = proposer * stride + seq`` so
+  (proposer, value_id) decode without a table.
+- ``vid <= -2``       : no-op hole fillers, generated *on device* by
+  the hole-filling pass, encoded ``-(2 + proposer * n_instances +
+  instance)`` so each (proposer, instance) no-op is distinct — the
+  reference gives each no-op a fresh (proposer, value_id) identity too
+  (ref multi/paxos.cpp:1124 ``Value(index_, ++value_id_)``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+NONE = jnp.int32(-1)
+NOOP_BASE = -2
+
+
+def real_vid(proposer, seq, stride):
+    """Canonical real-value id: globally unique, decodable without a table."""
+    return jnp.asarray(proposer, jnp.int32) * jnp.int32(stride) + jnp.asarray(
+        seq, jnp.int32
+    )
+
+
+def real_proposer_of(vid, stride):
+    return jnp.asarray(vid, jnp.int32) // jnp.int32(stride)
+
+
+def real_seq_of(vid, stride):
+    return jnp.asarray(vid, jnp.int32) % jnp.int32(stride)
+
+
+def noop_vid(instance, proposer, n_instances):
+    """Device-side no-op id for hole filling; distinct per (proposer, instance)."""
+    k = jnp.asarray(proposer, jnp.int32) * jnp.int32(n_instances) + jnp.asarray(
+        instance, jnp.int32
+    )
+    return jnp.int32(NOOP_BASE) - k
+
+
+def is_noop(vid):
+    return jnp.asarray(vid, jnp.int32) <= jnp.int32(NOOP_BASE)
+
+
+def is_none(vid):
+    return jnp.asarray(vid, jnp.int32) == NONE
+
+
+def noop_decode(vid, n_instances):
+    """(proposer, instance) of a no-op vid — host or device."""
+    k = jnp.int32(NOOP_BASE) - jnp.asarray(vid, jnp.int32)
+    return k // jnp.int32(n_instances), k % jnp.int32(n_instances)
+
+
+# ---------------------------------------------------------------- host side
+
+
+class InternTable:
+    """Host-side payload intern table: bytes/str <-> vid.
+
+    The harness seam the reference exposes as ``StateMachine::Debug``
+    (ref multi/paxos.h:214-222): a way to render a value.  Real
+    payloads are interned on propose; no-ops never enter the table.
+    """
+
+    def __init__(self) -> None:
+        self._by_payload: dict[bytes, int] = {}
+        self._payloads: list[bytes] = []
+
+    def intern(self, payload: bytes | str) -> int:
+        if isinstance(payload, str):
+            payload = payload.encode()
+        vid = self._by_payload.get(payload)
+        if vid is None:
+            vid = len(self._payloads)
+            self._by_payload[payload] = vid
+            self._payloads.append(payload)
+        return vid
+
+    def payload(self, vid: int) -> bytes:
+        if not 0 <= vid < len(self._payloads):
+            raise KeyError(f"vid {vid} is not an interned real value")
+        return self._payloads[vid]
+
+    def __len__(self) -> int:
+        return len(self._payloads)
+
+
+def decode_host(vid: int, stride: int, n_instances: int):
+    """Decode a vid to (proposer, value_id, noop) on host (numpy ints ok)."""
+    vid = int(vid)
+    if vid <= NOOP_BASE:
+        k = NOOP_BASE - vid
+        return k // n_instances, k % n_instances, True
+    if vid < 0:
+        raise ValueError("NONE has no decoding")
+    return vid // stride, vid % stride, False
+
+
+def decode_host_array(vids: np.ndarray, stride: int, n_instances: int):
+    """Vectorized host decode: returns (proposer, value_id, noop) arrays."""
+    vids = np.asarray(vids, np.int64)
+    if (vids == int(NONE)).any():
+        raise ValueError("NONE has no decoding")
+    noop = vids <= NOOP_BASE
+    k = NOOP_BASE - vids
+    proposer = np.where(noop, k // n_instances, vids // stride)
+    value_id = np.where(noop, k % n_instances, vids % stride)
+    return proposer.astype(np.int64), value_id.astype(np.int64), noop

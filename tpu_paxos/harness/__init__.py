@@ -1,0 +1,1 @@
+"""Simulation harnesses, validation invariants, CLI."""

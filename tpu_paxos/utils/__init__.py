@@ -1,0 +1,1 @@
+"""L0 primitives: PRNG streams, host logging."""

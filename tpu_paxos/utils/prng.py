@@ -1,0 +1,32 @@
+"""Deterministic randomness — the replacement for the reference's LCG.
+
+The reference seeds a hand-rolled LCG (``x = x*1103515245 + 12345``,
+ref multi/paxos.h:172-185) and, in member/, derives child thread seeds
+from the parent's stream so record/replay runs see identical random
+sequences (ref member/indet.h:111-131).  Here the same property comes
+from counter-based ``jax.random``: every consumer folds a static tag
+and the round number into the root key, so randomness is a pure
+function of (seed, tag, round) — replay for free, and identical across
+hosts in a multi-host mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Stable stream tags (fold_in indices). Adding a stream = appending here.
+STREAM_PREPARE_DELAY = 0
+STREAM_NET_DROP = 1
+STREAM_NET_DUP = 2
+STREAM_NET_DELAY = 3
+STREAM_CRASH = 4
+STREAM_WORKLOAD = 5
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def stream(key: jax.Array, tag: int, round_idx) -> jax.Array:
+    """Key for one (stream, round) — pure function of its inputs."""
+    return jax.random.fold_in(jax.random.fold_in(key, tag), round_idx)
