@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Headline benchmark: Paxos instances/sec to chosen value.
+
+Runs BASELINE.md config 2 — 5 nodes, 1M instances, single chip — as
+the steady-state flow of one prepared proposer: phase-1 once, then
+batched accept + commit windows over fresh instances (the reference's
+long-running proposer does exactly this: one prepare, then batched
+accepts for every subsequent proposal, ref multi/paxos.cpp:1256-1275).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "instances/sec", "vs_baseline": N}
+
+vs_baseline is measured against the repo's north-star target of 10M
+instances/sec (BASELINE.json) — the reference itself publishes no
+numbers (BASELINE.md), so >1.0 means the north star is beaten.
+
+Environment knobs: TPU_PAXOS_BENCH_INSTANCES (window size, default 1M),
+TPU_PAXOS_BENCH_NODES (default 5), TPU_PAXOS_BENCH_REPS (windows per
+timed call, default 32), TPU_PAXOS_BENCH_SHARDED=1 (use every visible
+device via shard_map — BASELINE config 4 shape).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import fast
+from tpu_paxos.core import values as val
+
+NORTH_STAR = 10_000_000.0  # instances/sec, BASELINE.json north_star
+
+
+def _steady_state_windows(
+    state: fast.FastState, vids0, reps: int, quorum: int, span: int | None = None
+):
+    """Phase-1 once, then `reps` accept+learn windows over fresh
+    instance windows (state arrays recycled as the sliding window)."""
+    _, ballot = bal.bump_past(
+        jnp.int32(0), jnp.int32(0), jnp.max(state.max_seen)
+    )
+    state, prepared, _, _ = fast.phase1_prepare(state, ballot, quorum)
+
+    def window(carry, k):
+        st, total = carry
+        # A fresh window of instances: clear per-instance state, new vids.
+        st = st._replace(
+            acc_ballot=jnp.full_like(st.acc_ballot, bal.NONE),
+            acc_vid=jnp.full_like(st.acc_vid, val.NONE),
+            learned=jnp.full_like(st.learned, val.NONE),
+        )
+        # Window k proposes a globally fresh vid range (span = global
+        # instance count, not the shard-local slice size).
+        vids = jnp.where(
+            prepared, vids0 + k * jnp.int32(span or vids0.shape[0]), val.NONE
+        )
+        st, chosen = fast.phase2_accept(st, ballot, vids, quorum)
+        st = fast.phase3_learn(st, vids, chosen)
+        n = jnp.sum((st.learned[:, 0] != val.NONE).astype(jnp.int32))
+        return (st, total + n), None
+
+    (state, total), _ = jax.lax.scan(
+        window, (state, jnp.int32(0)), jnp.arange(reps, dtype=jnp.int32)
+    )
+    return state, total
+
+
+def main() -> None:
+    n_inst = int(os.environ.get("TPU_PAXOS_BENCH_INSTANCES", 1_000_000))
+    n_nodes = int(os.environ.get("TPU_PAXOS_BENCH_NODES", 5))
+    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 32))
+    use_sharded = os.environ.get("TPU_PAXOS_BENCH_SHARDED", "0") == "1"
+    quorum = n_nodes // 2 + 1
+
+    vids0 = jnp.arange(n_inst, dtype=jnp.int32)
+
+    if use_sharded and len(jax.devices()) > 1:
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.parallel import sharded as psharded
+        from jax.sharding import PartitionSpec as P
+
+        mesh = pmesh.make_instance_mesh()
+        n_inst -= n_inst % mesh.size or 0
+        vids0 = pmesh.shard_instances(mesh, jnp.arange(n_inst, dtype=jnp.int32))
+        state = psharded.init_sharded_state(mesh, n_inst, n_nodes)
+        def _local(st, v):
+            st, local_total = _steady_state_windows(
+                st, v, reps=reps, quorum=quorum, span=n_inst
+            )
+            return st, jax.lax.psum(local_total, pmesh.INSTANCE_AXIS)
+
+        body = jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(psharded._state_specs(), P(pmesh.INSTANCE_AXIS)),
+            out_specs=(psharded._state_specs(), P()),
+            check_vma=False,
+        )
+        step = jax.jit(body, donate_argnums=(0,))
+    else:
+        state = fast.init_state(n_inst, n_nodes)
+        step = jax.jit(
+            functools.partial(_steady_state_windows, reps=reps, quorum=quorum),
+            donate_argnums=(0,),
+        )
+
+    # Warmup / compile.
+    state2, total = step(state, vids0)
+    total.block_until_ready()
+    assert int(total) == n_inst * reps, f"warmup chose {int(total)}"
+
+    t0 = time.perf_counter()
+    state3, total = step(state2, vids0)
+    total.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    n_chosen = int(total)
+    assert n_chosen == n_inst * reps, f"bench chose {n_chosen}"
+    rate = n_chosen / dt
+    print(
+        json.dumps(
+            {
+                "metric": "paxos_instances_per_sec_to_chosen",
+                "value": round(rate, 1),
+                "unit": "instances/sec",
+                "vs_baseline": round(rate / NORTH_STAR, 3),
+                "config": {
+                    "n_nodes": n_nodes,
+                    "n_instances_per_window": n_inst,
+                    "windows": reps,
+                    "sharded": bool(use_sharded and len(jax.devices()) > 1),
+                    "devices": len(jax.devices()),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,19 +2,14 @@
 
 Real multi-chip hardware is not available in CI; sharding correctness
 is validated on a virtual 8-device CPU mesh exactly as the driver's
-dryrun does (xla_force_host_platform_device_count).  This must run
-before jax initializes, hence top of conftest.
+dryrun does.  Note: this environment preloads jax via sitecustomize
+with the TPU platform selected, so env vars are too late — the
+platform must be switched through jax.config before any backend
+initialization (first device/array use).
 """
 
-import os
+import jax
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,59 @@
+"""bench.py must print exactly one parseable JSON line with the
+required keys (the driver parses it verbatim)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra):
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["JAX_PLATFORMS"] = "cpu"
+    # The axon sitecustomize (on PYTHONPATH) breaks
+    # xla_force_host_platform_device_count; drop it for CPU subprocesses.
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=600,
+        check=True,
+    )
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, f"expected one JSON line, got: {out.stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_bench_json_contract():
+    rec = _run(
+        {
+            "TPU_PAXOS_BENCH_INSTANCES": "4096",
+            "TPU_PAXOS_BENCH_REPS": "2",
+        }
+    )
+    assert rec["metric"] == "paxos_instances_per_sec_to_chosen"
+    assert rec["unit"] == "instances/sec"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+
+
+def test_bench_sharded_mode():
+    rec = _run(
+        {
+            "TPU_PAXOS_BENCH_INSTANCES": "4096",
+            "TPU_PAXOS_BENCH_REPS": "2",
+            "TPU_PAXOS_BENCH_SHARDED": "1",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    assert rec["config"]["sharded"] is True
+    assert rec["config"]["devices"] == 8

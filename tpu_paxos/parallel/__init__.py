@@ -1,0 +1,1 @@
+"""Scale-out: device meshes and instance-axis sharded round loops."""

@@ -1,0 +1,51 @@
+"""Device mesh construction — the distributed communication backend.
+
+The reference's backend is the ``NetWork`` SPI over in-process queues
+(ref multi/paxos.h:193-212, multi/main.cpp:51-162).  Here the backend
+is the XLA collective layer: consensus state is sharded over the
+*instance* axis of a ``jax.sharding.Mesh`` (Paxos instances are
+embarrassingly parallel — only proposer-global scalars need
+communication), so the only cross-chip traffic is tiny ``pmax``/
+``psum`` reductions of per-acceptor scalars, which ride ICI inside a
+slice and DCN across slices.
+
+Mesh axes:
+- ``i``: instance-axis shards (ICI). All [instances, ...] arrays are
+  split along it.
+- per-acceptor scalars ([nodes]-shaped) are replicated.
+
+Multi-host: ``jax.distributed.initialize()`` + the same mesh spanning
+all processes gives the DCN scale-out path; the round functions are
+unchanged because shard_map hides the topology.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+INSTANCE_AXIS = "i"
+
+
+def make_instance_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the instance axis.  ``n_devices=None`` uses every
+    visible device (the v5e-8 slice in the target config)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return jax.make_mesh((len(devices),), (INSTANCE_AXIS,), devices=devices)
+
+
+def instance_spec() -> P:
+    """Spec for [instances, ...] arrays: split dim 0 over the mesh."""
+    return P(INSTANCE_AXIS)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_instances(mesh: Mesh, arr):
+    """Place an [I, ...] array sharded over the instance axis."""
+    return jax.device_put(arr, NamedSharding(mesh, instance_spec()))

@@ -1,0 +1,96 @@
+"""Instance-axis sharded protocol rounds (shard_map + collectives).
+
+The [instances, nodes] SoA state is split along instances across the
+mesh; per-acceptor scalars (promised, max_seen) are replicated.  The
+only cross-shard communication the protocol needs is:
+
+- ``pmax`` of the max-ballot-seen when a proposer picks a new ballot
+  (the global analog of ref multi/paxos.cpp:792-799's max_proposal_id_),
+- ``psum`` of chosen counts for the quiescence predicate
+  (the reference's "total executed" counter, ref multi/main.cpp:329).
+
+Everything else — promise compares, adoption, accept stores, learning —
+is local to a shard, which is why this scales linearly over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import fast
+from tpu_paxos.core import values as val
+from tpu_paxos.parallel.mesh import INSTANCE_AXIS
+
+
+def _state_specs() -> fast.FastState:
+    """PartitionSpec pytree for FastState: [I, A] arrays split over
+    instances, [A] scalars replicated."""
+    return fast.FastState(
+        promised=P(),
+        max_seen=P(),
+        acc_ballot=P(INSTANCE_AXIS),
+        acc_vid=P(INSTANCE_AXIS),
+        learned=P(INSTANCE_AXIS),
+    )
+
+
+def _choose_all_local(state: fast.FastState, vids, proposer: int, quorum: int):
+    """Per-shard body of the fused choose-all: identical to the
+    single-chip fast path except the ballot is derived from the
+    *global* max ballot seen (pmax over shards)."""
+    global_max = jax.lax.pmax(jnp.max(state.max_seen), INSTANCE_AXIS)
+    _, ballot = bal.bump_past(jnp.int32(0), jnp.int32(proposer), global_max)
+
+    state, prepared, adopted_ballot, adopted_vid = fast.phase1_prepare(
+        state, ballot, quorum
+    )
+    use_adopted = adopted_ballot != bal.NONE
+    batch = jnp.where(use_adopted, adopted_vid, vids)
+    batch = jnp.where(prepared, batch, val.NONE)
+    state, chosen = fast.phase2_accept(state, ballot, batch, quorum)
+    state = fast.phase3_learn(state, batch, chosen)
+
+    local_chosen = jnp.sum((state.learned[:, 0] != val.NONE).astype(jnp.int32))
+    n_chosen = jax.lax.psum(local_chosen, INSTANCE_AXIS)
+    return state, n_chosen
+
+
+def sharded_choose_all(mesh: Mesh, proposer: int, quorum: int):
+    """Build the jitted, shard_map'd choose-all for a mesh.
+
+    Returns ``fn(state, vids) -> (state, n_chosen)`` where [I, ...]
+    inputs are sharded over the instance axis.
+    """
+    body = functools.partial(
+        _choose_all_local, proposer=proposer, quorum=quorum
+    )
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_state_specs(), P(INSTANCE_AXIS)),
+        out_specs=(_state_specs(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def init_sharded_state(mesh: Mesh, n_instances: int, n_nodes: int) -> fast.FastState:
+    """FastState with [I, A] arrays laid out over the instance axis."""
+    if n_instances % mesh.size != 0:
+        raise ValueError(
+            f"n_instances ({n_instances}) must divide evenly over "
+            f"{mesh.size} devices"
+        )
+    state = fast.init_state(n_instances, n_nodes)
+    from jax.sharding import NamedSharding
+
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), _state_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.tree.map(jax.device_put, state, shardings)
