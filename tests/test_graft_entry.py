@@ -1,7 +1,16 @@
-"""Driver contract: entry() jit-compiles; dryrun_multichip(8) runs on
-the virtual CPU mesh and keeps invariants."""
+"""Driver contract: entry() jit-compiles; dryrun_multichip(8) works both
+in-process (devices available) and via subprocess re-exec when jax is
+already initialized on a too-small backend — the exact pattern the
+driver uses (it runs bench on the 1-chip TPU backend first, then calls
+dryrun_multichip(8))."""
+
+import os
+import subprocess
+import sys
 
 import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_entry_compiles_and_runs():
@@ -16,3 +25,36 @@ def test_dryrun_multichip_8():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_with_jax_preinitialized_small():
+    """Reproduce the driver environment: jax initialized on a 1-device
+    backend before dryrun_multichip is called.  MULTICHIP_r02 failed
+    exactly here; the fix re-execs in a clean subprocess."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_num_cpu_devices', 1)\n"
+        "assert len(jax.devices()) == 1\n"  # backend initialized, 1 device
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('PREINIT_OK')\n"
+    )
+    import __graft_entry__ as g
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + g.scrub_pythonpath(env.get("PYTHONPATH", ""))
+    )
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "PREINIT_OK" in proc.stdout
+    assert "dryrun_multichip ok" in proc.stdout
