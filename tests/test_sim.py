@@ -1,0 +1,239 @@
+"""General-engine tests: the TPU equivalents of the reference's
+self-checking simulations (ref multi/main.cpp harness semantics).
+
+Every run finishes by checking the whole-run invariants from
+harness/validate.py — agreement, exactly-once vs the expected value
+set, identical executed sequences (ref multi/main.cpp:567-573)."""
+
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, ProtocolConfig, SimConfig
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import sim
+from tpu_paxos.core import values as val
+from tpu_paxos.harness import validate
+from tpu_paxos.utils import prng
+
+
+def _check(r: sim.SimResult, expected=None):
+    assert r.done, f"sim did not quiesce in {r.rounds} rounds"
+    validate.check_all(
+        r.learned, r.expected_vids if expected is None else expected
+    )
+
+
+def test_single_proposer_fault_free():
+    r = sim.run(SimConfig(n_nodes=3, n_instances=16, proposers=(0,), seed=0))
+    _check(r)
+    # one prepare round trip + one accept + one commit + quiesce
+    assert r.rounds <= 10
+
+
+def test_five_nodes_single_proposer():
+    r = sim.run(SimConfig(n_nodes=5, n_instances=32, proposers=(2,), seed=1))
+    _check(r)
+
+
+def test_one_node_cluster():
+    # quorum 1: a 1-node cluster must still choose (degenerate Paxos)
+    r = sim.run(SimConfig(n_nodes=1, n_instances=8, proposers=(0,), seed=0))
+    _check(r)
+
+
+def test_dueling_proposers_baseline_config3():
+    """BASELINE config 3: 5-node, 2 dueling proposers, randomized
+    ballot backoff; liveness = bounded rounds-to-chosen."""
+    r = sim.run(SimConfig(n_nodes=5, n_instances=32, proposers=(0, 1), seed=0))
+    _check(r)
+    assert r.rounds_to_chosen.size > 0
+    assert r.rounds < 200  # liveness: anti-dueling backoff converges
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_reference_fault_rates(seed):
+    """The debug.conf.sample workload shape: drop 500/10000,
+    dup 1000/10000, delay 0..max (ref multi/debug.conf.sample:1),
+    two proposers contending."""
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=32,
+        proposers=(0, 1),
+        seed=seed,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, min_delay=0, max_delay=3),
+    )
+    r = sim.run(cfg)
+    _check(r)
+
+
+def test_heavy_drop_still_converges():
+    cfg = SimConfig(
+        n_nodes=3,
+        n_instances=8,
+        proposers=(0,),
+        seed=5,
+        max_rounds=50_000,
+        faults=FaultConfig(drop_rate=3000),  # 30% drop
+    )
+    r = sim.run(cfg)
+    _check(r)
+
+
+def test_adoption_and_noop_hole_fill():
+    """A dead proposer left a pre-accepted value at instance 2 on one
+    acceptor; the new proposer must adopt it, fill instances 0-1 with
+    no-ops (ref multi/paxos.cpp:1106-1130), and put its own values
+    above (ref multi/paxos.cpp:1047-1182)."""
+    cfg = SimConfig(n_nodes=3, n_instances=8, proposers=(0,), seed=0)
+    workload = [np.asarray([50, 51], np.int32)]
+    pend, gate, tail, c = sim.prepare_queues(cfg, workload)
+    root = prng.root_key(cfg.seed)
+    st = sim.init_state(cfg, pend, gate, tail, root)
+    # vid 999 pre-accepted at instance 2 on acceptor 0 only, from a
+    # proposer on node 2 that is now silent.
+    dead_ballot = int(bal.make(1, 2))
+    st = st._replace(
+        acc=st.acc._replace(
+            acc_ballot=st.acc.acc_ballot.at[2, 0].set(dead_ballot),
+            acc_vid=st.acc.acc_vid.at[2, 0].set(999),
+        )
+    )
+    r = sim.run_state(cfg, st, root, np.asarray([50, 51, 999]), c)
+    assert r.done
+    assert bool(val.is_noop(r.chosen_vid[0])) and bool(val.is_noop(r.chosen_vid[1]))
+    assert r.chosen_vid[2] == 999
+    assert set(r.chosen_vid[3:5].tolist()) == {50, 51}
+    validate.check_all(r.learned, np.asarray([50, 51, 999]))
+    # the no-op holes must not block the apply frontier
+    seqs = validate.check_executed_identical(r.learned)
+    assert [s.tolist() for s in seqs] == [[999, 50, 51]] * 3
+
+
+def test_conflict_reproposal():
+    """Proposer 0 initially assigned vid 100 to instance 0, but vid 777
+    (another node's value) was pre-accepted there at a higher ballot.
+    On commit of 777, vid 100 must be re-queued and re-chosen at a
+    fresh instance (ref multi/paxos.cpp:1540-1569)."""
+    cfg = SimConfig(n_nodes=3, n_instances=8, proposers=(0,), seed=0)
+    workload = [np.zeros((0,), np.int32)]
+    pend, gate, tail, c = sim.prepare_queues(cfg, workload)
+    root = prng.root_key(cfg.seed)
+    st = sim.init_state(cfg, pend, gate, tail, root)
+    rival = int(bal.make(7, 1))
+    st = st._replace(
+        acc=st.acc._replace(
+            acc_ballot=st.acc.acc_ballot.at[0, 1].set(rival),
+            acc_vid=st.acc.acc_vid.at[0, 1].set(777),
+        ),
+        prop=st.prop._replace(
+            own_assign=st.prop.own_assign.at[0, 0].set(100),
+        ),
+    )
+    expected = np.asarray([100, 777])
+    r = sim.run_state(cfg, st, root, expected, c)
+    assert r.done
+    assert r.chosen_vid[0] == 777
+    assert 100 in r.chosen_vid.tolist()
+    validate.check_all(r.learned, expected)
+
+
+def test_in_order_client_gating():
+    """In-order clients: each value proposable only after the previous
+    one is chosen (ref multi/main.cpp:398-401), and the executed order
+    must match proposal order (ref multi/main.cpp:202-212)."""
+    vids = np.asarray([10, 11, 12, 13], np.int32)
+    gates = [np.asarray([int(val.NONE), 10, 11, 12], np.int32)]
+    cfg = SimConfig(n_nodes=3, n_instances=16, proposers=(0,), seed=0)
+    r = sim.run(cfg, workload=[vids], gates=gates)
+    _check(r)
+    executed = validate.check_executed_identical(r.learned)[0]
+    validate.check_in_order_clients(executed, [vids])
+
+
+def test_in_order_under_faults_and_contention():
+    """In-order client on proposer 0 while proposer 1 floods free
+    values, under reference fault rates — order must still hold."""
+    inorder = np.asarray([10, 11, 12], np.int32)
+    gates = [
+        np.asarray([int(val.NONE), 10, 11], np.int32),
+        np.zeros((0,), np.int32),
+    ]
+    free = np.asarray([20, 21, 22, 23, 24], np.int32)
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=32,
+        proposers=(0, 1),
+        seed=2,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+    r = sim.run(cfg, workload=[inorder, free], gates=gates)
+    _check(r)
+    executed = validate.check_executed_identical(r.learned)
+    validate.check_in_order_clients(max(executed, key=len), [inorder])
+
+
+def test_crash_minority_safety_and_liveness():
+    """member/-style random fail-stop crashes, capped at a minority
+    (ref member/indet.h:146-150).  Safety must always hold; with a
+    surviving majority and a surviving proposer the run completes."""
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=16,
+        proposers=(0, 1),
+        seed=4,
+        max_rounds=50_000,
+        faults=FaultConfig(crash_rate=5_000),  # 0.5% per node per round
+    )
+    r = sim.run(cfg)
+    assert r.crashed.sum() <= 2  # minority cap
+    # safety regardless of liveness
+    validate.check_agreement(r.learned)
+    validate.check_executed_identical(r.learned)
+    if r.done:
+        validate.check_all(r.learned, r.expected_vids)
+
+
+def test_same_seed_identical_outcome():
+    """Determinism: the full decision record is a pure function of
+    (config, seed) — the engine-level half of the reference's
+    record/replay guarantee (ref member/run.sh:1-18)."""
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=32,
+        proposers=(0, 1),
+        seed=9,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=3),
+    )
+    r1, r2 = sim.run(cfg), sim.run(cfg)
+    np.testing.assert_array_equal(r1.chosen_vid, r2.chosen_vid)
+    np.testing.assert_array_equal(r1.chosen_round, r2.chosen_round)
+    np.testing.assert_array_equal(r1.chosen_ballot, r2.chosen_ballot)
+    np.testing.assert_array_equal(r1.learned, r2.learned)
+    np.testing.assert_array_equal(r1.msgs, r2.msgs)
+    assert r1.rounds == r2.rounds
+
+
+def test_different_seed_different_schedule():
+    """Different seeds must actually change the fault schedule (guards
+    against the PRNG being wired to nothing)."""
+    mk = lambda s: sim.run(  # noqa: E731
+        SimConfig(
+            n_nodes=5,
+            n_instances=32,
+            proposers=(0, 1),
+            seed=s,
+            faults=FaultConfig(drop_rate=2000, dup_rate=1000, max_delay=3),
+        )
+    )
+    r1, r2 = mk(1), mk(2)
+    assert r1.rounds != r2.rounds or not np.array_equal(
+        r1.chosen_round, r2.chosen_round
+    )
+
+
+def test_message_counters_populated():
+    r = sim.run(SimConfig(n_nodes=3, n_instances=16, proposers=(0,), seed=0))
+    # prepare, prepare_reply, accept, accept_reply, commit, commit_reply
+    assert r.msgs[0] > 0 and r.msgs[1] > 0
+    assert r.msgs[3] > 0 and r.msgs[4] > 0
+    assert r.msgs[5] > 0 and r.msgs[6] > 0

@@ -1,0 +1,204 @@
+"""Bulk-synchronous network: ring-calendar message buffers + fault masks.
+
+This is the TPU-native reframing of the reference's ``NetWork`` SPI and
+``THNetWork`` fault injector (ref multi/paxos.h:193-212,
+multi/main.cpp:51-162).  Point-to-point async messages become entries
+in fixed-size *arrival calendars*: for each message type there is a
+ring buffer whose leading axis is "arrives in k rounds"; a message
+sent at round ``t`` with sampled delay ``d`` is written at slot
+``(t + 1 + d) % S`` and popped when the round counter reaches it.
+
+Fault semantics follow ``THNetWork::HijackSend``
+(ref multi/main.cpp:116-132) exactly:
+- the original copy is dropped with probability drop_rate/10000;
+- duplicates are spawned recursively with probability dup_rate/10000,
+  up to 3 extra copies, and duplicates are never dropped (the
+  reference's drop check runs only for ``dup == 0``);
+- every surviving copy independently samples a uniform integer delay
+  in [min_delay, max_delay] rounds (the reference delays in ms via its
+  Timer; one round here is one message exchange).
+
+Coalescing model: at most one message per (edge, type) is delivered
+per round; when two in-flight copies land on the same slot the
+higher-ballot / newer one wins.  Every such coalescing artifact is
+equivalent to a legal drop-and-delay schedule of the reference
+network, because all proposer→acceptor messages are broadcasts of
+idempotent content and replies only collide with older replies on the
+same edge — so the engine's reachable interleavings are a subset of
+the reference network's.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_paxos.config import FaultConfig
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import values as val
+
+MAX_COPIES = 4  # original + up to 3 recursive duplicates, ref multi/main.cpp:120
+
+
+class NetBuffers(NamedTuple):
+    """Arrival calendars, leading axis S = max_delay + 2 ring slots.
+
+    P = number of proposers, A = number of nodes (acceptors/learners),
+    I = instance capacity.  ``NONE`` (-1) marks "no message".
+    """
+
+    # PREPARE (ref MSG_PREPARE): proposer -> acceptor, ballot only (the
+    # interval-set payload is implicit: all instances).
+    prep_req: jax.Array  # [S, P, A] int32 ballot
+    # PREPARE_REPLY (granted only, ref MSG_PREPARE_REPLY): acceptor ->
+    # proposer, echo ballot + snapshot of the acceptor's accepted state.
+    prep_echo: jax.Array  # [S, A, P] int32 ballot echo
+    prep_ab: jax.Array  # [S, A, P, I] int32 accepted-ballot snapshot
+    prep_av: jax.Array  # [S, A, P, I] int32 accepted-vid snapshot
+    # REJECT (ref MSG_REJECT, shared by both phases): max ballot seen.
+    rej: jax.Array  # [S, A, P] int32 max ballot (NONE = no reject)
+    # ACCEPT (ref MSG_ACCEPT): per-edge ballot + per-proposer value
+    # batch (content is identical across edges — a broadcast).
+    acc_req: jax.Array  # [S, P, A] int32 ballot (NONE = no message)
+    acc_bat: jax.Array  # [S, P, I] int32 vid batch content
+    acc_bat_ballot: jax.Array  # [S, P] int32 ballot of stored content
+    # ACCEPT_REPLY (ref MSG_ACCEPT_REPLY): echo + per-instance acks.
+    acc_echo: jax.Array  # [S, A, P] int32 ballot echo
+    acc_ack: jax.Array  # [S, A, P, I] bool instance acked
+    # COMMIT (ref MSG_COMMIT): chosen-value batch to every node.
+    com_pres: jax.Array  # [S, P, A] bool edge presence
+    com_bat: jax.Array  # [S, P, I] int32 chosen vids (NONE = not in batch)
+    # COMMIT_REPLY (ref MSG_COMMIT_REPLY): per-instance acks.
+    com_ack: jax.Array  # [S, A, P, I] bool
+
+
+def init_buffers(s: int, p: int, a: int, i: int) -> NetBuffers:
+    none = lambda *shape: jnp.full(shape, bal.NONE, jnp.int32)  # noqa: E731
+    false = lambda *shape: jnp.zeros(shape, jnp.bool_)  # noqa: E731
+    return NetBuffers(
+        prep_req=none(s, p, a),
+        prep_echo=none(s, a, p),
+        prep_ab=none(s, a, p, i),
+        prep_av=none(s, a, p, i),
+        rej=none(s, a, p),
+        acc_req=none(s, p, a),
+        acc_bat=none(s, p, i),
+        acc_bat_ballot=none(s, p),
+        acc_echo=none(s, a, p),
+        acc_ack=false(s, a, p, i),
+        com_pres=false(s, p, a),
+        com_bat=none(s, p, i),
+        com_ack=false(s, a, p, i),
+    )
+
+
+def clear_slot(buffers: NetBuffers, slot) -> NetBuffers:
+    """Zero the just-popped arrival slot so the ring can be rewritten."""
+
+    def _clr(buf):
+        fill = jnp.zeros((), buf.dtype) if buf.dtype == jnp.bool_ else bal.NONE
+        return buf.at[slot].set(fill)
+
+    return jax.tree.map(_clr, buffers)
+
+
+def copy_plan(key: jax.Array, edge_shape: tuple[int, ...], fc: FaultConfig):
+    """Sample the THNetWork fault plan for one broadcast/send.
+
+    Returns (alive [MAX_COPIES, *edge_shape] bool,
+             delay [MAX_COPIES, *edge_shape] int32): which of the up to
+    4 copies of each edge's message survive, and each copy's delay in
+    rounds.  Copy 0 is the original (droppable); copies 1..3 exist via
+    the recursive duplication chain and are never dropped
+    (ref multi/main.cpp:116-123).
+    """
+    k_drop, k_dup, k_delay = jax.random.split(key, 3)
+    drop = (
+        jax.random.randint(k_drop, edge_shape, 0, 10_000) < fc.drop_rate
+        if fc.drop_rate
+        else jnp.zeros(edge_shape, jnp.bool_)
+    )
+    if fc.dup_rate:
+        coins = (
+            jax.random.randint(k_dup, (MAX_COPIES - 1, *edge_shape), 0, 10_000)
+            < fc.dup_rate
+        )
+        # Recursive chain: copy k+1 exists iff copy k spawned it.
+        dup1 = coins[0]
+        dup2 = dup1 & coins[1]
+        dup3 = dup2 & coins[2]
+        dups = jnp.stack([dup1, dup2, dup3])
+    else:
+        dups = jnp.zeros((MAX_COPIES - 1, *edge_shape), jnp.bool_)
+    alive = jnp.concatenate([(~drop)[None], dups], axis=0)
+    if fc.max_delay:
+        delay = jax.random.randint(
+            k_delay,
+            (MAX_COPIES, *edge_shape),
+            fc.min_delay,
+            fc.max_delay + 1,
+            dtype=jnp.int32,
+        )
+    else:
+        delay = jnp.zeros((MAX_COPIES, *edge_shape), jnp.int32)
+    return alive, delay
+
+
+def _slot_onehot(t, s: int, alive, delay):
+    """[MAX_COPIES, *edge] arrival slots -> [S, *edge] bool write mask."""
+    slots = (t + 1 + delay) % s  # arrival round's ring slot
+    oh = jnp.arange(s).reshape((s,) + (1,) * slots[0].ndim)
+    # any copy of the edge's message lands on slot s'
+    return jnp.any((slots[None] == oh[:, None]) & alive[None], axis=1)
+
+
+def write_ballot(buf, t, alive, delay, value, send_mask):
+    """Coalesce-max write of a ballot-valued message into its calendar.
+
+    ``value``/``send_mask`` are per-edge; NONE means no send.
+    """
+    s = buf.shape[0]
+    mask = _slot_onehot(t, s, alive, delay) & send_mask[None]
+    return jnp.maximum(buf, jnp.where(mask, value[None], bal.NONE))
+
+
+def write_bool(buf, t, alive, delay, value, send_mask):
+    """Coalesce-or write of boolean per-instance payloads ([.., I])."""
+    s = buf.shape[0]
+    mask = _slot_onehot(t, s, alive, delay) & send_mask[None]
+    return buf | (mask[..., None] & value[None])
+
+
+def write_row(buf, t, alive, delay, value, send_mask, newer):
+    """Write per-edge [I]-rows; overwrite an existing row iff ``newer``
+    ([S, *edge] bool, computed by the caller from echo ballots across
+    all slots)."""
+    s = buf.shape[0]
+    mask = _slot_onehot(t, s, alive, delay) & send_mask[None] & newer
+    return jnp.where(mask[..., None], value[None], buf)
+
+
+def write_content(bat, bat_ballot, t, alive, delay, content, ballot, send):
+    """Per-proposer broadcast content ([P, I] vids at [P] ballot):
+    higher-ballot content replaces, equal-ballot content merges
+    (union of non-NONE entries — in-flight accept batches at one
+    ballot cover disjoint or identical instances)."""
+    s = bat.shape[0]
+    # content is per-proposer; it must be present at every slot where
+    # ANY surviving copy of ANY edge's message arrives (the content
+    # calendar is per [S, P] while delivery is per-edge).
+    slots = (t + 1 + delay) % s  # [C, P, A]
+    oh = jnp.arange(s).reshape((s, 1, 1, 1))
+    arrive = jnp.any((slots[None] == oh) & alive[None], axis=(1, 3))  # [S, P]
+    mask = arrive & send[None]
+    newer = mask & (ballot[None] > bat_ballot)
+    equal = mask & (ballot[None] == bat_ballot)
+    new_bat = jnp.where(newer[..., None], content[None], bat)
+    new_bat = jnp.where(
+        equal[..., None] & (content[None] != val.NONE), content[None], new_bat
+    )
+    new_ballot = jnp.where(newer, ballot[None], bat_ballot)
+    return new_bat, new_ballot
+

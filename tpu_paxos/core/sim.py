@@ -1,0 +1,834 @@
+"""The general multi-round engine: fault-tolerant multi-Paxos as a
+bulk-synchronous round loop (``lax.while_loop`` over pure array ops).
+
+This is the TPU-native equivalent of the reference's event-driven
+protocol core (ref multi/paxos.cpp:320-521 ``PaxosImpl`` state,
+:1643-1706 ``Loop``): every node's proposer/acceptor/learner state
+lives in SoA arrays, one loop iteration is one network round, and all
+asynchrony — retries, randomized backoff, drops, duplicates, delays —
+is expressed as per-round masks, counters, and arrival-calendar
+buffers (core/net.py).
+
+Protocol semantics (each with its reference anchor):
+- promise iff ballot strictly > promised; equal ballots get silence,
+  lower get REJECT with the max ballot seen
+  (ref multi/paxos.cpp:858-899 OnPrepare);
+- prepare replies snapshot the acceptor's accepted AND committed
+  values (committed reported at a +inf-like ballot so adoption always
+  prefers them — ref FilterAcceptedValues includes committed_values_,
+  multi/paxos.cpp:913-922);
+- adoption merges pre-accepted values by max ballot as replies arrive
+  (ref multi/paxos.cpp:1201-1223 UpdateByPreAcceptedValues);
+- accept iff ballot >= promised (ref multi/paxos.cpp:1366), with one
+  deliberate deviation: an acceptor only overwrites its accepted
+  value when the new ballot is >= the currently *accepted* ballot,
+  and only acks the instances it actually stored.  The reference
+  overwrites with any ballot >= promised (multi/paxos.cpp:1385) and
+  acks the whole batch, which under reordered delivery can report a
+  stale lower-ballot value to a later prepare and lose a chosen
+  value; keeping the highest-ballot accepted value is the standard
+  safe acceptor rule (Lamport's Voting.tla) and is a superset of the
+  behaviours the reference exhibits in its own test configs;
+- per-acceptor promised is a single scalar covering all instances
+  (ref: one ``promised_proposal_id_`` member) — this is what makes
+  hole-filling and the in-order-client property work;
+- retry ladder: prepare resent (count-1) times then restart with a
+  bumped ballot after a randomized anti-dueling delay
+  (ref multi/paxos.cpp:757-801, 1244-1247); accept resent then falls
+  back to prepare (AcceptRejected, ref :969-983, 1328-1343); commit
+  retried until every node replied (ref :1022-1027, 1625-1641);
+- REJECT only updates the proposer's max-ballot-seen — the deadline
+  ladder performs the actual restart (ref multi/paxos.cpp:1224-1230
+  OnReject);
+- batch assembly at prepare quorum: adopted pre-accepted values
+  first, then no-op hole fills for every gap below the open tail
+  (including over the proposer's own earlier assignments — they wait
+  for conflict re-proposal), then own initial proposals still in the
+  open tail, then new values at the lowest free instances
+  (ref multi/paxos.cpp:1047-1182 OnPrepareReply);
+- conflict re-proposal: when an instance a proposer initially
+  assigned commits with a different value, the displaced value is
+  re-queued and assigned a fresh instance
+  (ref multi/paxos.cpp:1540-1569 OnCommit).
+
+Fault injection (drop/dup/delay per THNetWork, crash per member/'s
+RandomFailure) rides the network layer — see core/net.py.  Crashes
+are fail-stop node silences capped at a minority of nodes (the
+reference's member/ crash aborts the whole run and validates the
+prefix; here the run continues on the surviving majority and the same
+prefix validation applies).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.config import SimConfig
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import net as netm
+from tpu_paxos.core import values as val
+from tpu_paxos.utils import prng
+
+# Proposer modes
+DELAY = jnp.int32(0)  # waiting out the randomized prepare delay
+PREPARING = jnp.int32(1)  # prepare broadcast in flight
+PREPARED = jnp.int32(2)  # phase-1 quorum held; accepts in flight
+
+# Ballot reported for committed values in prepare-reply snapshots so
+# adoption always prefers them (they are chosen; re-proposing them is
+# always safe).  Real ballots stay far below (count << 16 | node).
+COMMITTED_BALLOT = jnp.int32(2**30)
+
+_NEG = jnp.int32(jnp.iinfo(jnp.int32).min)  # -inf sentinel for masked max
+
+# How many queue entries a proposer may assign per round (static
+# window for the gated-assignment scan; re-proposals and large
+# workloads simply take extra rounds).
+ASSIGN_WINDOW = 64
+
+
+class AcceptorState(NamedTuple):
+    promised: jax.Array  # [A] int32 scalar promised ballot per acceptor
+    max_seen: jax.Array  # [A] int32 max ballot ever seen
+    acc_ballot: jax.Array  # [I, A] int32 accepted ballot (NONE none)
+    acc_vid: jax.Array  # [I, A] int32 accepted vid
+
+
+class ProposerState(NamedTuple):
+    mode: jax.Array  # [P] int32 DELAY / PREPARING / PREPARED
+    count: jax.Array  # [P] int32 ballot count
+    ballot: jax.Array  # [P] int32 current ballot
+    pmax_seen: jax.Array  # [P] int32 max ballot seen via rejects
+    delay_until: jax.Array  # [P] int32 round to start the next prepare
+    prep_deadline: jax.Array  # [P] int32
+    prep_retries: jax.Array  # [P] int32
+    promises: jax.Array  # [P, A] bool promises for current ballot
+    adopted_b: jax.Array  # [P, I] int32 adopted pre-accepted ballot
+    adopted_v: jax.Array  # [P, I] int32 adopted pre-accepted vid
+    cur_batch: jax.Array  # [P, I] int32 vids being accepted at ballot
+    acks: jax.Array  # [P, I, A] bool per-instance accept acks
+    acc_deadline: jax.Array  # [P] int32
+    acc_retries: jax.Array  # [P] int32
+    own_assign: jax.Array  # [P, I] int32 own initial proposals by instance
+    pend: jax.Array  # [P, C] int32 pending-value ring
+    gate: jax.Array  # [P, C] int32 vid that must be chosen first (NONE free)
+    head: jax.Array  # [P] int32 ring head (absolute)
+    tail: jax.Array  # [P] int32 ring tail (absolute)
+    commit_vid: jax.Array  # [P, I] int32 values this proposer is committing
+    commit_acked: jax.Array  # [P, I, A] bool
+    commit_deadline: jax.Array  # [P] int32
+
+
+class Metrics(NamedTuple):
+    chosen_vid: jax.Array  # [I] int32 decided value (NONE undecided)
+    chosen_round: jax.Array  # [I] int32 round of decision
+    chosen_ballot: jax.Array  # [I] int32 deciding ballot
+    msgs: jax.Array  # [7] int32 logical sends per message type
+
+
+class SimState(NamedTuple):
+    t: jax.Array  # int32 round counter (the virtual clock)
+    acc: AcceptorState
+    learned: jax.Array  # [I, A] int32 learner state per node
+    prop: ProposerState
+    net: netm.NetBuffers
+    met: Metrics
+    crashed: jax.Array  # [A] bool fail-stop crash mask
+    done: jax.Array  # bool quiescence predicate
+
+
+@dataclasses.dataclass(frozen=True)
+class SimResult:
+    learned: np.ndarray  # [I, A]
+    chosen_vid: np.ndarray  # [I]
+    chosen_round: np.ndarray  # [I]
+    chosen_ballot: np.ndarray  # [I]
+    rounds: int
+    done: bool
+    crashed: np.ndarray  # [A] bool
+    msgs: np.ndarray  # [7] logical send counts
+    expected_vids: np.ndarray  # union of workload vids (all proposers)
+
+    @property
+    def rounds_to_chosen(self) -> np.ndarray:
+        """Per decided instance, rounds from t=0 to decision."""
+        return self.chosen_round[self.chosen_vid != int(val.NONE)]
+
+
+def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
+    a, i = cfg.n_nodes, cfg.n_instances
+    p = len(cfg.proposers)
+    c = pend.shape[1]
+    s = cfg.faults.max_delay + 2
+    k0 = prng.stream(root, prng.STREAM_PREPARE_DELAY, 0)
+    delay0 = jax.random.randint(
+        k0,
+        (p,),
+        cfg.protocol.prepare_delay_min,
+        cfg.protocol.prepare_delay_max + 1,
+        dtype=jnp.int32,
+    )
+    none = lambda *sh: jnp.full(sh, bal.NONE, jnp.int32)  # noqa: E731
+    return SimState(
+        t=jnp.int32(0),
+        acc=AcceptorState(
+            promised=jnp.zeros((a,), jnp.int32),
+            max_seen=jnp.zeros((a,), jnp.int32),
+            acc_ballot=none(i, a),
+            acc_vid=none(i, a),
+        ),
+        learned=none(i, a),
+        prop=ProposerState(
+            mode=jnp.full((p,), DELAY, jnp.int32),
+            count=jnp.zeros((p,), jnp.int32),
+            ballot=jnp.zeros((p,), jnp.int32),
+            pmax_seen=jnp.zeros((p,), jnp.int32),
+            delay_until=delay0,
+            prep_deadline=jnp.zeros((p,), jnp.int32),
+            prep_retries=jnp.zeros((p,), jnp.int32),
+            promises=jnp.zeros((p, a), jnp.bool_),
+            adopted_b=none(p, i),
+            adopted_v=none(p, i),
+            cur_batch=none(p, i),
+            acks=jnp.zeros((p, i, a), jnp.bool_),
+            acc_deadline=jnp.zeros((p,), jnp.int32),
+            acc_retries=jnp.zeros((p,), jnp.int32),
+            own_assign=none(p, i),
+            pend=pend,
+            gate=gate,
+            head=jnp.zeros((p,), jnp.int32),
+            tail=tail,
+            commit_vid=none(p, i),
+            commit_acked=jnp.zeros((p, i, a), jnp.bool_),
+            commit_deadline=jnp.zeros((p,), jnp.int32),
+        ),
+        net=netm.init_buffers(s, p, a, i),
+        met=Metrics(
+            chosen_vid=none(i),
+            chosen_round=none(i),
+            chosen_ballot=none(i),
+            msgs=jnp.zeros((7,), jnp.int32),
+        ),
+        crashed=jnp.zeros((a,), jnp.bool_),
+        done=jnp.bool_(False),
+    )
+
+
+def _select_by_argmax(values_pi, cand_pia):
+    """values [P, I], cand [P, I, A] masked ballots: per (i, a) pick
+    values[argmax_p cand, i] (NONE when no candidate)."""
+    best_b = jnp.max(cand_pia, axis=0)  # [I, A]
+    best_p = jnp.argmax(cand_pia, axis=0)  # [I, A]
+    sel = jnp.arange(cand_pia.shape[0])[:, None, None] == best_p[None]
+    v = jnp.max(jnp.where(sel, values_pi[:, :, None], _NEG), axis=0)
+    return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
+
+
+def _assignable_window(pend, gate, head, tail, chosen_vid, c):
+    """First-fit view of the head window: which of the next W queue
+    entries are live and gate-satisfied.  Gated entries (the in-order
+    client seam, ref multi/main.cpp:398-401: next value only after the
+    previous one's callback) do NOT block later entries — the
+    reference's propose queue is a set, and a conflict-requeued value
+    must be able to run ahead of entries gated on it.
+
+    Returns (qpos [P, W] ring positions, qvid [P, W], ok [P, W])."""
+    offs = jnp.arange(ASSIGN_WINDOW)
+    qpos = jnp.clip(head[:, None] + offs[None], 0, c - 1)  # [P, W] absolute
+    live = ((head[:, None] + offs[None]) < tail[:, None]) & (
+        jnp.take_along_axis(pend, qpos, axis=1) != val.NONE
+    )
+    qvid = jnp.take_along_axis(pend, qpos, axis=1)
+    g = jnp.take_along_axis(gate, qpos, axis=1)  # [P, W]
+    g_chosen = jnp.any(
+        g[:, :, None] == chosen_vid[None, None, :], axis=-1
+    ) & (g != val.NONE)
+    ok = live & ((g == val.NONE) | g_chosen)
+    return qpos, qvid, ok
+
+
+def build_engine(cfg: SimConfig, n_pend_cap: int):
+    """Compile-time closure: returns ``round_fn(root_key, state) ->
+    state`` plus static geometry.  Everything data-dependent lives in
+    the state; everything shape-like is baked in."""
+    a, i_cap = cfg.n_nodes, cfg.n_instances
+    p = len(cfg.proposers)
+    c = n_pend_cap
+    quorum = cfg.quorum
+    pc, fc = cfg.protocol, cfg.faults
+    pn = jnp.asarray(cfg.proposers, jnp.int32)  # [P] proposer -> node
+    idx = jnp.arange(i_cap, dtype=jnp.int32)
+    max_crash = (a - 1) // 2
+
+    def round_fn(root: jax.Array, st: SimState) -> SimState:
+        t = st.t
+        s = st.net.prep_req.shape[0]
+        slot = t % s
+        ar = jax.tree.map(lambda b: b[slot], st.net)
+        net = netm.clear_slot(st.net, slot)
+
+        alive_a = ~st.crashed  # [A]
+        prop_alive = alive_a[pn]  # [P]
+
+        keys = jax.random.split(prng.stream(root, prng.STREAM_NET_DROP, t), 8)
+
+        # ---------------- acceptor side ----------------
+        acc = st.acc
+        learned = st.learned
+        # Snapshot (pre-accept) for prepare replies; committed values
+        # are included at COMMITTED_BALLOT (ref FilterAcceptedValues
+        # includes committed_values_, multi/paxos.cpp:913-922).
+        snap_b = jnp.where(learned != val.NONE, COMMITTED_BALLOT, acc.acc_ballot)
+        snap_v = jnp.where(learned != val.NONE, learned, acc.acc_vid)
+
+        # PREPARE arrivals (crashed acceptors ignore everything).
+        preq = jnp.where(alive_a[None, :], ar.prep_req, bal.NONE)  # [P, A]
+        grant = preq > acc.promised[None, :]  # strict >, ref :866
+        rej_prep = (preq != bal.NONE) & (preq < acc.promised[None, :])
+        max_seen = jnp.maximum(acc.max_seen, jnp.max(preq, axis=0))
+        promised = jnp.maximum(
+            acc.promised, jnp.max(jnp.where(grant, preq, bal.NONE), axis=0)
+        )
+
+        # ACCEPT arrivals.
+        apres = jnp.where(alive_a[None, :], ar.acc_req, bal.NONE)  # [P, A]
+        abal = ar.acc_bat_ballot  # [P] content ballot
+        abat = ar.acc_bat  # [P, I]
+        has_acc = apres != bal.NONE
+        max_seen = jnp.maximum(
+            max_seen,
+            jnp.max(jnp.where(has_acc, abal[:, None], bal.NONE), axis=0),
+        )
+        elig = has_acc & (abal[:, None] >= promised)  # >=, ref :1366
+        rej_acc = has_acc & ~elig
+        w_has = abat != val.NONE  # [P, I]
+        is_comm = learned != val.NONE  # [I, A]
+        # Per-instance ack: store-or-match (see module docstring for
+        # the deviation from the reference's blanket batch ack).
+        ack = (
+            elig[:, None, :]
+            & w_has[:, :, None]
+            & jnp.where(
+                is_comm[None],
+                abat[:, :, None] == learned[None],
+                abal[:, None, None] >= acc.acc_ballot[None],
+            )
+        )  # [P, I, A]
+        cand = jnp.where(ack & ~is_comm[None], abal[:, None, None], bal.NONE)
+        store_v, store_b = _select_by_argmax(abat, cand)
+        do_store = store_b != bal.NONE
+        acc_ballot = jnp.where(do_store, store_b, acc.acc_ballot)
+        acc_vid = jnp.where(do_store, store_v, acc.acc_vid)
+
+        # COMMIT arrivals -> learner state (ref OnCommit,
+        # multi/paxos.cpp:1494-1518).
+        cpres = ar.com_pres & alive_a[None, :]  # [P, A]
+        cbat = ar.com_bat  # [P, I]
+        inc = cpres[:, None, :] & (cbat != val.NONE)[:, :, None]  # [P, I, A]
+        has_inc = jnp.any(inc, axis=0)  # [I, A]
+        inc_v = jnp.max(jnp.where(inc, cbat[:, :, None], _NEG), axis=0)
+        learned = jnp.where(has_inc & (learned == val.NONE), inc_v, learned)
+
+        acc = AcceptorState(promised, max_seen, acc_ballot, acc_vid)
+
+        # ---------------- proposer side ----------------
+        pr = st.prop
+        # REJECT arrivals only update max-ballot-seen (ref OnReject).
+        rejs = jnp.where(alive_a[:, None], ar.rej, bal.NONE)  # [A, P]
+        pmax_seen = jnp.maximum(pr.pmax_seen, jnp.max(rejs, axis=0))
+
+        # PREPARE_REPLY arrivals: promises + adoption merge.
+        pecho = jnp.where(alive_a[:, None], ar.prep_echo, bal.NONE)  # [A, P]
+        match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
+        promises2 = pr.promises | match.T  # [P, A]
+        pab = jnp.moveaxis(ar.prep_ab, 0, -1)  # [P, I, A]
+        pav = jnp.moveaxis(ar.prep_av, 0, -1)
+        repb = jnp.where(match.T[:, None, :], pab, bal.NONE)  # [P, I, A]
+        best_a = jnp.argmax(repb, axis=-1)  # [P, I]
+        best_b = jnp.max(repb, axis=-1)  # [P, I]
+        best_v = jnp.take_along_axis(pav, best_a[..., None], axis=-1)[..., 0]
+        take = (best_b != bal.NONE) & (best_b > pr.adopted_b)
+        adopted_b = jnp.where(take, best_b, pr.adopted_b)
+        adopted_v = jnp.where(take, best_v, pr.adopted_v)
+
+        # Phase-1 quorum -> PREPARED; build the accept batch skeleton
+        # (adopted values + noop hole fills + own initial proposals;
+        # new values are assigned in the shared step below).
+        n_prom = jnp.sum(promises2, axis=1)
+        now_prepared = (
+            (pr.mode == PREPARING) & (n_prom >= quorum) & prop_alive
+        )
+        committed_p = (learned[:, :] != val.NONE)[:, pn].T  # [P, I]
+        use_adopt = ~committed_p & (adopted_b != bal.NONE)
+        covered0 = committed_p | use_adopt
+        hi = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)  # [P]
+        below = idx[None] <= hi[:, None]
+        noop_fill = below & ~covered0
+        own_has = pr.own_assign != val.NONE
+        use_own = ~below & own_has
+        batch0 = jnp.where(
+            use_adopt,
+            adopted_v,
+            jnp.where(
+                noop_fill,
+                val.noop_vid(idx[None], pn[:, None], i_cap),
+                jnp.where(use_own, pr.own_assign, val.NONE),
+            ),
+        )
+        batch0 = jnp.where(committed_p, val.NONE, batch0)
+        mode = jnp.where(now_prepared, PREPARED, pr.mode)
+        cur_batch = jnp.where(now_prepared[:, None], batch0, pr.cur_batch)
+        acks = jnp.where(now_prepared[:, None, None], False, pr.acks)
+        acc_retries = jnp.where(
+            now_prepared, pc.accept_retry_count, pr.acc_retries
+        )
+        acc_deadline = jnp.where(
+            now_prepared, t + 1 + pc.accept_retry_timeout, pr.acc_deadline
+        )
+
+        # New-value assignment for every PREPARED proposer: gate-ready
+        # queue entries (first-fit) onto the lowest free instances in
+        # the open tail (ref unproposed_instance_ids_.Next).
+        can_assign = (mode == PREPARED) & prop_alive
+        activity = (
+            committed_p | (cur_batch != val.NONE) | (pr.own_assign != val.NONE)
+        )
+        hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)  # [P]
+        free = idx[None] > hi2[:, None]  # [P, I]
+        qpos, qvid, ok = _assignable_window(
+            pr.pend, pr.gate, pr.head, pr.tail, st.met.chosen_vid, c
+        )
+        ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1  # [P, W]
+        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+        k = jnp.minimum(jnp.sum(ok, axis=1), jnp.sum(free, axis=1))
+        k = jnp.where(can_assign, k, 0)
+        take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
+        # vid of the r-th taken entry, gatherable by free_rank
+        w = ASSIGN_WINDOW
+        rank_oh = (
+            ok_rank[:, :, None] == jnp.arange(w)[None, None, :]
+        ) & take_q[:, :, None]  # [P, W, R]
+        by_rank = jnp.max(
+            jnp.where(rank_oh, qvid[:, :, None], _NEG), axis=1
+        )  # [P, R]
+        takev = free & (free_rank < k[:, None])  # instances filled
+        newv = jnp.take_along_axis(
+            by_rank, jnp.clip(free_rank, 0, w - 1), axis=1
+        )  # [P, I]
+        cur_batch = jnp.where(takev, newv, cur_batch)
+        own_assign = jnp.where(takev, newv, pr.own_assign)
+        # consume taken entries in place (scatter NONE at exactly the
+        # taken ring slots; untaken window positions are redirected out
+        # of range and dropped), then advance head over the leading
+        # consumed run
+        prow = jnp.arange(p)[:, None]
+        pos_taken = jnp.where(take_q, qpos, c)
+        pend = pr.pend.at[prow, pos_taken].set(
+            jnp.full_like(qpos, val.NONE), mode="drop"
+        )
+        lead_dead = (
+            (pr.head[:, None] + jnp.arange(w)[None]) < pr.tail[:, None]
+        ) & (jnp.take_along_axis(pend, qpos, axis=1) == val.NONE)
+        head = pr.head + jnp.sum(
+            jnp.cumprod(lead_dead.astype(jnp.int32), axis=1), axis=1
+        )
+        added = k > 0
+
+        # ACCEPT_REPLY arrivals: per-instance acks for current ballot.
+        aecho = jnp.where(alive_a[:, None], ar.acc_echo, bal.NONE)  # [A, P]
+        amatch = (aecho == pr.ballot[None, :]) & (mode[None, :] == PREPARED)
+        acks = acks | (jnp.moveaxis(ar.acc_ack, 0, -1) & amatch.T[:, None, :])
+        n_ack = jnp.sum(acks, axis=-1)  # [P, I]
+        inst_chosen = (cur_batch != val.NONE) & (n_ack >= quorum)
+        newly = inst_chosen & (pr.commit_vid == val.NONE) & prop_alive[:, None]
+        commit_vid = jnp.where(newly, cur_batch, pr.commit_vid)
+
+        # Decision metrics (the decision log's source of truth).
+        any_new = jnp.any(newly, axis=0) & (st.met.chosen_vid == val.NONE)
+        new_v = jnp.max(jnp.where(newly, cur_batch, _NEG), axis=0)
+        new_b = jnp.max(jnp.where(newly, pr.ballot[:, None], _NEG), axis=0)
+        met = st.met._replace(
+            chosen_vid=jnp.where(any_new, new_v, st.met.chosen_vid),
+            chosen_round=jnp.where(any_new, t, st.met.chosen_round),
+            chosen_ballot=jnp.where(any_new, new_b, st.met.chosen_ballot),
+        )
+
+        # COMMIT sends: newly chosen + deadline resends of batches not
+        # yet acked by every live node (ref :1625-1641 retries until
+        # ALL nodes replied; crashed nodes are excused).
+        commit_acked = pr.commit_acked | jnp.moveaxis(ar.com_ack, 0, -1)
+        not_all_acked = (commit_vid != val.NONE) & ~jnp.all(
+            commit_acked | st.crashed[None, None, :], axis=-1
+        )
+        resend_c = (t >= pr.commit_deadline)[:, None] & not_all_acked
+        send_commit_i = (newly | resend_c) & prop_alive[:, None]  # [P, I]
+        send_commit = jnp.any(send_commit_i, axis=1)
+        com_content = jnp.where(send_commit_i, commit_vid, val.NONE)
+        commit_deadline = jnp.where(
+            send_commit, t + 1 + pc.commit_retry_timeout, pr.commit_deadline
+        )
+
+        # Conflict re-proposal + own-value completion
+        # (ref OnCommit, multi/paxos.cpp:1540-1569).
+        learned_p = learned[:, :][:, pn].T  # [P, I] post-commit view
+        own_has2 = own_assign != val.NONE
+        conflict = own_has2 & (learned_p != val.NONE) & (learned_p != own_assign)
+        own_done = own_has2 & (learned_p == own_assign)
+        nreq = jnp.sum(conflict, axis=1)  # [P]
+        req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
+        # scatter requeued vids at the queue tail (absolute positions,
+        # capacity-proof — see prepare_queues; non-conflict rows are
+        # redirected out of range and dropped)
+        req_pos = jnp.where(conflict, pr.tail[:, None] + req_rank, c)
+        pend = pend.at[prow, req_pos].set(own_assign, mode="drop")
+        gate = pr.gate.at[prow, req_pos].set(  # requeues are ungated
+            jnp.full_like(req_pos, val.NONE), mode="drop"
+        )
+        tail = pr.tail + nreq
+        own_assign = jnp.where(conflict | own_done, val.NONE, own_assign)
+
+        # ---------------- timers / mode ladder ----------------
+        # PREPARING deadline: resend (count-1 times) then restart with
+        # a bumped ballot (ref PrepareRetryTimeout, :757-790).
+        pdl = (mode == PREPARING) & (t >= pr.prep_deadline) & prop_alive
+        resend_prep = pdl & (pr.prep_retries > 1)
+        restart_p = pdl & (pr.prep_retries <= 1)
+        prep_retries = jnp.where(resend_prep, pr.prep_retries - 1, pr.prep_retries)
+        prep_deadline = jnp.where(
+            resend_prep, t + 1 + pc.prepare_retry_timeout, pr.prep_deadline
+        )
+
+        # Accept deadline: resend outstanding then AcceptRejected ->
+        # back to prepare (ref AcceptRetryTimeout, :955-983, 1328-1343).
+        outstanding = (
+            (cur_batch != val.NONE)
+            & (commit_vid == val.NONE)
+            & ~committed_p
+        )
+        adl = (
+            (mode == PREPARED)
+            & jnp.any(outstanding, axis=1)
+            & (t >= acc_deadline)
+            & prop_alive
+        )
+        resend_acc = adl & (acc_retries > 1)
+        acc_fail = adl & (acc_retries <= 1)
+        acc_retries = jnp.where(resend_acc, acc_retries - 1, acc_retries)
+
+        do_restart = restart_p | acc_fail
+        rnd_delay = jax.random.randint(
+            prng.stream(root, prng.STREAM_PREPARE_DELAY, t + 1),
+            (p,),
+            pc.prepare_delay_min,
+            pc.prepare_delay_max + 1,
+            dtype=jnp.int32,
+        )
+        delay_until = jnp.where(do_restart, t + 1 + rnd_delay, pr.delay_until)
+        mode = jnp.where(do_restart, DELAY, mode)
+        promises2 = jnp.where(do_restart[:, None], False, promises2)
+        adopted_b = jnp.where(do_restart[:, None], bal.NONE, adopted_b)
+        adopted_v = jnp.where(do_restart[:, None], val.NONE, adopted_v)
+        cur_batch = jnp.where(do_restart[:, None], val.NONE, cur_batch)
+        acks = jnp.where(do_restart[:, None, None], False, acks)
+
+        # DELAY -> send prepare with a ballot bumped past everything
+        # seen (ref UpdateProposalID, :792-799).
+        start_prep = (mode == DELAY) & (t >= delay_until) & prop_alive
+        ncount, nballot = bal.bump_past(
+            pr.count, pn, jnp.maximum(pmax_seen, pr.ballot)
+        )
+        count = jnp.where(start_prep, ncount, pr.count)
+        ballot = jnp.where(start_prep, nballot, pr.ballot)
+        mode = jnp.where(start_prep, PREPARING, mode)
+        prep_retries = jnp.where(start_prep, pc.prepare_retry_count, prep_retries)
+        prep_deadline = jnp.where(
+            start_prep, t + 1 + pc.prepare_retry_timeout, prep_deadline
+        )
+        promises2 = jnp.where(start_prep[:, None], False, promises2)
+        adopted_b = jnp.where(start_prep[:, None], bal.NONE, adopted_b)
+        adopted_v = jnp.where(start_prep[:, None], val.NONE, adopted_v)
+
+        send_prep = start_prep | resend_prep
+        send_accept = (now_prepared | added | resend_acc) & jnp.any(
+            cur_batch != val.NONE, axis=1
+        )
+
+        # ---------------- network writes ----------------
+        edge_pa = (p, a)
+        # prepare requests
+        al, dl = netm.copy_plan(keys[0], edge_pa, fc)
+        net = net._replace(
+            prep_req=netm.write_ballot(
+                net.prep_req, t, al, dl, ballot[:, None], send_prep[:, None]
+            )
+        )
+        # prepare replies (granted only) + snapshots
+        al, dl = netm.copy_plan(keys[1], (a, p), fc)
+        send_rep = grant.T  # [A, P]
+        echo_val = preq.T  # [A, P] the granted ballot
+        newer = echo_val[None] >= net.prep_echo  # [S, A, P]
+        net = net._replace(
+            prep_echo=netm.write_ballot(
+                net.prep_echo, t, al, dl, echo_val, send_rep
+            ),
+            prep_ab=netm.write_row(
+                net.prep_ab, t, al, dl,
+                jnp.broadcast_to(snap_b.T[:, None, :], (a, p, i_cap)),
+                send_rep, newer,
+            ),
+            prep_av=netm.write_row(
+                net.prep_av, t, al, dl,
+                jnp.broadcast_to(snap_v.T[:, None, :], (a, p, i_cap)),
+                send_rep, newer,
+            ),
+        )
+        # rejects (both phases share one message, ref MSG_REJECT)
+        al, dl = netm.copy_plan(keys[2], (a, p), fc)
+        send_rej = (rej_prep | rej_acc).T
+        net = net._replace(
+            rej=netm.write_ballot(
+                net.rej, t, al, dl,
+                jnp.broadcast_to(max_seen[:, None], (a, p)), send_rej,
+            )
+        )
+        # accepts: per-edge ballot + per-proposer batch content
+        al, dl = netm.copy_plan(keys[3], edge_pa, fc)
+        net = net._replace(
+            acc_req=netm.write_ballot(
+                net.acc_req, t, al, dl, ballot[:, None], send_accept[:, None]
+            )
+        )
+        nb_, nbb_ = netm.write_content(
+            net.acc_bat, net.acc_bat_ballot, t, al, dl,
+            cur_batch, ballot, send_accept,
+        )
+        net = net._replace(acc_bat=nb_, acc_bat_ballot=nbb_)
+        # accept replies
+        al, dl = netm.copy_plan(keys[4], (a, p), fc)
+        send_arep = elig.T  # [A, P] reply whenever ballot >= promised
+        aecho_val = jnp.broadcast_to(abal[None, :], (a, p))
+        newer_a = aecho_val[None] >= net.acc_echo
+        net = net._replace(
+            acc_echo=netm.write_ballot(
+                net.acc_echo, t, al, dl, aecho_val, send_arep
+            ),
+            acc_ack=netm.write_row(
+                net.acc_ack, t, al, dl,
+                jnp.moveaxis(ack, 2, 0), send_arep, newer_a,
+            ),
+        )
+        # commits: per-edge presence + per-proposer content (merged by
+        # union — commits never disagree, that's the agreement invariant)
+        al, dl = netm.copy_plan(keys[5], edge_pa, fc)
+        arrive_pa = netm._slot_onehot(t, s, al, dl)  # [S, P, A]
+        net = net._replace(
+            com_pres=net.com_pres
+            | (arrive_pa & send_commit[None, :, None]),
+            com_bat=jnp.where(
+                (jnp.any(arrive_pa, axis=-1) & send_commit[None, :])[..., None]
+                & (com_content[None] != val.NONE),
+                com_content[None],
+                net.com_bat,
+            ),
+        )
+        # commit replies: ack every instance present in the commit
+        al, dl = netm.copy_plan(keys[6], (a, p), fc)
+        crep_rows = jnp.moveaxis(inc, 2, 0)  # [A, P, I]
+        send_crep = cpres.T  # [A, P]
+        net = net._replace(
+            com_ack=netm.write_bool(
+                net.com_ack, t, al, dl, crep_rows, send_crep
+            )
+        )
+
+        # message counters (logical sends, pre-fault)
+        msgs = met.msgs + jnp.stack(
+            [
+                jnp.sum(send_prep) * a,
+                jnp.sum(send_rep),
+                jnp.sum(send_rej),
+                jnp.sum(send_accept) * a,
+                jnp.sum(send_arep),
+                jnp.sum(send_commit) * a,
+                jnp.sum(send_crep),
+            ]
+        ).astype(jnp.int32)
+        met = met._replace(msgs=msgs)
+
+        # ---------------- crash injection ----------------
+        crashed = st.crashed
+        if fc.crash_rate:
+            ku = prng.stream(root, prng.STREAM_CRASH, t)
+            u = jax.random.randint(ku, (a,), 0, 1_000_000)
+            want = (u < fc.crash_rate) & ~crashed
+            room = max_crash - jnp.sum(crashed)
+            allow = jnp.cumsum(want.astype(jnp.int32)) <= room
+            crashed = crashed | (want & allow)
+
+        # ---------------- quiescence ----------------
+        alive2 = ~crashed
+        palive2 = alive2[pn]
+        q_empty = jnp.all((head == tail) | ~palive2)
+        own_none = jnp.all((own_assign == val.NONE) | ~palive2[:, None])
+        hmax = jnp.max(
+            jnp.where(met.chosen_vid != val.NONE, idx, -1)
+        )
+        contiguous = jnp.all(
+            (met.chosen_vid != val.NONE) | (idx > hmax)
+        )
+        learned_ok = jnp.all(
+            (learned != val.NONE) | crashed[None, :] | (idx[:, None] > hmax)
+        )
+        done = q_empty & own_none & contiguous & learned_ok & (t > 0)
+
+        return SimState(
+            t=t + 1,
+            acc=acc,
+            learned=learned,
+            prop=ProposerState(
+                mode=mode,
+                count=count,
+                ballot=ballot,
+                pmax_seen=pmax_seen,
+                delay_until=delay_until,
+                prep_deadline=prep_deadline,
+                prep_retries=prep_retries,
+                promises=promises2,
+                adopted_b=adopted_b,
+                adopted_v=adopted_v,
+                cur_batch=cur_batch,
+                acks=acks,
+                acc_deadline=jnp.where(
+                    resend_acc, t + 1 + pc.accept_retry_timeout, acc_deadline
+                ),
+                acc_retries=acc_retries,
+                own_assign=own_assign,
+                pend=pend,
+                gate=gate,
+                head=head,
+                tail=tail,
+                commit_vid=commit_vid,
+                commit_acked=commit_acked,
+                commit_deadline=commit_deadline,
+            ),
+            net=net,
+            met=met,
+            crashed=crashed,
+            done=done,
+        )
+
+    return round_fn
+
+
+def default_workload(cfg: SimConfig) -> list[np.ndarray]:
+    """``n_instances // 2`` values split round-robin over the
+    proposers, leaving instance headroom for no-op fills."""
+    p = len(cfg.proposers)
+    stride = max(cfg.n_instances, 1024)
+    total = max(cfg.n_instances // 2, 1)
+    counts = [total // p + (1 if pi < total % p else 0) for pi in range(p)]
+    return [
+        np.asarray([pi * stride + s for s in range(counts[pi])], np.int32)
+        for pi in range(p)
+    ]
+
+
+def prepare_queues(
+    cfg: SimConfig,
+    workload: list[np.ndarray],
+    gates: list[np.ndarray] | None = None,
+):
+    """Build the (pend, gate, tail) queue arrays from per-proposer
+    value sequences; returns (pend, gate, tail, capacity).
+
+    The queue uses absolute (non-wrapping) indices: per proposer, each
+    instance can receive at most one own-assignment over the whole run
+    (assignments only target instances above the committed high-water
+    mark, and a conflicted instance is committed), so total enqueues
+    are bounded by initial workload + n_instances and the capacity
+    below can never overflow."""
+    p = len(cfg.proposers)
+    c = max(len(wl) for wl in workload) + cfg.n_instances + 8
+    pend = np.full((p, c), int(val.NONE), np.int32)
+    gate = np.full((p, c), int(val.NONE), np.int32)
+    tail = np.zeros((p,), np.int32)
+    for pi, wl in enumerate(workload):
+        wl = np.asarray(wl, np.int32)
+        if len(wl) > c:
+            raise ValueError(f"workload for proposer {pi} exceeds queue cap")
+        pend[pi, : len(wl)] = wl
+        tail[pi] = len(wl)
+        if gates is not None and len(gates[pi]):
+            g = np.asarray(gates[pi], np.int32)
+            gate[pi, : len(g)] = g
+    return pend, gate, tail, c
+
+
+def init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
+    """Public initial-state constructor (tests seed custom acceptor
+    state through this)."""
+    return _init_state(
+        cfg, jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), root
+    )
+
+
+def run_state(
+    cfg: SimConfig,
+    state: SimState,
+    root: jax.Array,
+    expected_vids: np.ndarray,
+    queue_cap: int,
+) -> SimResult:
+    """Drive a prepared SimState to quiescence (or cfg.max_rounds)."""
+    round_fn = build_engine(cfg, queue_cap)
+
+    @jax.jit
+    def _go(root, state):
+        def cond(st):
+            return (~st.done) & (st.t < cfg.max_rounds)
+
+        def body(st):
+            return round_fn(root, st)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    final = _go(root, state)
+    return SimResult(
+        learned=np.asarray(final.learned),
+        chosen_vid=np.asarray(final.met.chosen_vid),
+        chosen_round=np.asarray(final.met.chosen_round),
+        chosen_ballot=np.asarray(final.met.chosen_ballot),
+        rounds=int(final.t),
+        done=bool(final.done),
+        crashed=np.asarray(final.crashed),
+        msgs=np.asarray(final.met.msgs),
+        expected_vids=expected_vids,
+    )
+
+
+def run(
+    cfg: SimConfig,
+    workload: list[np.ndarray] | None = None,
+    gates: list[np.ndarray] | None = None,
+) -> SimResult:
+    """Run the engine to quiescence (or cfg.max_rounds).
+
+    ``workload[p]`` is the vid sequence proposer ``p`` proposes;
+    ``gates[p][k]`` (optional) is the vid that must be chosen before
+    entry ``k`` becomes proposable (in-order clients) or ``NONE``.
+    """
+    p = len(cfg.proposers)
+    if workload is None:
+        workload = default_workload(cfg)
+    pend, gate, tail, c = prepare_queues(cfg, workload, gates)
+    root = prng.root_key(cfg.seed)
+    state = init_state(cfg, pend, gate, tail, root)
+    expected = np.unique(
+        np.concatenate([np.asarray(w, np.int32).reshape(-1) for w in workload])
+    )
+    return run_state(cfg, state, root, expected, c)
