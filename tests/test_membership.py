@@ -1,0 +1,145 @@
+"""Membership engine tests — member/ parity.
+
+Mirrors the reference churn harness: a 1-node bootstrap cluster grows
+by AddAcceptor (waiting for Applied between changes, ref
+member/main.cpp:121-146), values are proposed round-robin while churn
+is in flight (ref member/main.cpp:204-212), acceptors are then
+deleted, and every node's applied log must be a prefix of node 0's
+(ref member/main.cpp:260-265)."""
+
+import numpy as np
+import pytest
+
+from tpu_paxos.harness import validate
+from tpu_paxos.membership import (
+    ADD_ACCEPTOR,
+    DEL_ACCEPTOR,
+    MemberSim,
+    change_vid,
+    decode_change,
+)
+
+
+def _drain(ms: MemberSim, vids) -> None:
+    ok = ms.run_until(lambda: all(ms.chosen(v) for v in vids), max_rounds=2000)
+    assert ok, f"values not chosen after {int(ms.state.t)} rounds"
+
+
+def _check_prefix(ms: MemberSim, n: int):
+    logs = [ms.applied_log(i) for i in range(n)]
+    validate.check_prefix_consistency(logs)
+    return logs
+
+
+def test_change_vid_roundtrip():
+    for node in (0, 3, 6):
+        for kind in range(8):
+            assert decode_change(change_vid(node, kind)) == (node, kind)
+
+
+def test_bootstrap_single_node_chooses():
+    ms = MemberSim(n_nodes=3, n_instances=16, seed=0)
+    ms.propose(0, 5)
+    _drain(ms, [5])
+    assert ms.applied(5)
+    assert ms.applied_log(0).tolist() == [5]
+
+
+def test_add_acceptor_updates_views_and_version():
+    ms = MemberSim(n_nodes=3, n_instances=16, seed=0)
+    vid = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(vid), max_rounds=400)
+    assert ms.acceptor_set(0) == {0, 1}
+    assert ms.acceptor_set(1) == {0, 1}
+    v = np.asarray(ms.state.version)
+    assert v[0] == 1 and v[1] == 1  # acceptor change bumps version
+    assert v[2] == 0  # node 2 is not a member yet
+
+
+def test_del_acceptor():
+    ms = MemberSim(n_nodes=3, n_instances=32, seed=0)
+    a = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(a), max_rounds=400)
+    d = ms.del_acceptor(1, via=0)
+    assert ms.run_until(lambda: ms.applied(d), max_rounds=400)
+    assert ms.acceptor_set(0) == {0}
+    assert 1 not in ms.learner_set(0)  # DEL_ACCEPTOR demotes to gone
+    assert np.asarray(ms.state.version)[0] == 2
+
+
+def test_proposals_during_membership_change():
+    """Values proposed while a change is in flight must still land
+    exactly once, with prefix-consistent logs."""
+    ms = MemberSim(n_nodes=3, n_instances=32, seed=0)
+    ms.propose(0, 100)
+    c = ms.add_acceptor(1)
+    ms.propose(0, 101)
+    assert ms.run_until(
+        lambda: ms.applied(c) and ms.chosen(100) and ms.chosen(101),
+        max_rounds=800,
+    )
+    logs = _check_prefix(ms, 2)
+    assert sorted(logs[0].tolist()) == [100, 101]
+
+
+def test_churn_grow_then_shrink_baseline_config5():
+    """The member/main.cpp churn schedule at n=5 (grow 1->5 by
+    AddAcceptor, values interleaved, then shrink back), plus growth to
+    7 — covering BASELINE config 5's 5->7 reconfiguration mid-log."""
+    n = 7
+    ms = MemberSim(n_nodes=n, n_instances=96, seed=0)
+    next_vid = [0]
+
+    def burst(k=2, via=0):
+        out = []
+        for _ in range(k):
+            v = next_vid[0]
+            next_vid[0] += 1
+            ms.propose(via, v)
+            out.append(v)
+        return out
+
+    proposed = []
+    # grow 1 -> 5 (the member/ run.sh shape), proposing between changes
+    for tgt in range(1, 5):
+        proposed += burst()
+        c = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(c), max_rounds=2000), tgt
+    assert ms.acceptor_set(0) == {0, 1, 2, 3, 4}
+    # mid-log 5 -> 7 reconfiguration (BASELINE config 5)
+    for tgt in (5, 6):
+        proposed += burst()
+        c = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(c), max_rounds=2000), tgt
+    assert ms.acceptor_set(0) == set(range(7))
+    # values proposed via later members too
+    proposed += burst(via=3)
+    _drain(ms, proposed)
+    # shrink back to {0}
+    for tgt in range(1, 7):
+        c = ms.del_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(c), max_rounds=2000), tgt
+    assert ms.acceptor_set(0) == {0}
+    proposed_final = burst()
+    _drain(ms, proposed_final)
+
+    logs = _check_prefix(ms, n)
+    # node 0 applied every real value exactly once
+    assert sorted(logs[0].tolist()) == sorted(proposed + proposed_final)
+    counts = np.unique(logs[0], return_counts=True)[1]
+    assert (counts == 1).all()
+
+
+def test_version_gates_stale_accepts():
+    """A proposer with a stale view must not get values accepted until
+    it catches up (ref member/paxos.cpp:1702, 1747): after a change
+    applies, the old version's quorum is dead."""
+    ms = MemberSim(n_nodes=3, n_instances=32, seed=0)
+    c = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(c), max_rounds=400)
+    v0 = int(np.asarray(ms.state.version)[0])
+    # both members now at the same version; a proposal still lands
+    ms.propose(1, 200)
+    assert ms.run_until(lambda: ms.chosen(200), max_rounds=800)
+    assert int(np.asarray(ms.state.version)[1]) == v0
+    _check_prefix(ms, 2)

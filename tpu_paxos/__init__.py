@@ -12,18 +12,19 @@ expressed as per-round masks and counters driven by ``jax.random``.
 
 Layer map (mirrors SURVEY.md §1 for the reference):
 
-- L0 primitives:   ``utils/`` (PRNG streams, round counters, logging)
-- L1 determinism:  ``replay/`` (seeded replay, decision logs)
-- L2 embedder SPI: ``config.py`` + harness seams (workload, network
-  fault model, state-machine apply hooks)
-- L3 protocol:     ``core/`` (acceptor/proposer/learner round fns)
+- L0 primitives:   ``utils/prng.py`` (deterministic PRNG streams)
+- L1 determinism:  ``replay/`` (decision logs in the reference
+  grammar; replay = re-execution from the same seed)
+- L2 embedder SPI: ``config.py`` (protocol/fault/sim knobs)
+- L3 protocol:     ``core/fast.py`` (fused fault-free pipeline),
+  ``core/sim.py`` (general fault-tolerant multi-round engine),
+  ``core/net.py`` (arrival calendars + THNetWork fault masks),
+  ``core/ballot.py``, ``core/apply.py``
 - L4 value model:  ``core/values.py`` (interned int32 value ids)
-- L5 harness:      ``harness/`` (simulators, validation, CLI)
+- L5 harness:      ``harness/`` (whole-run invariant validation)
 - scale-out:       ``parallel/`` (mesh, shard_map round loops)
-- membership:      ``membership/`` (member/ parity: role masks,
-  versions, reconfiguration)
-- native runtime:  ``native/`` (C++ decision-log codec + invariant
-  checker, loaded via ctypes)
+- membership:      ``membership/`` (member/ parity: per-node role
+  views, version-gated quorums, live reconfiguration)
 """
 
 from tpu_paxos.config import (

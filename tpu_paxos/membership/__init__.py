@@ -1,0 +1,43 @@
+"""Membership engine — member/ parity (live reconfiguration).
+
+The reference's ``member/`` variant supports live membership change:
+roles form a ladder Learner <-> Proposer <-> Acceptor with six
+transition types (ref member/paxos.cpp:61-69), composite operations
+like AddAcceptor = [ADD_LEARNER, LEARNER_TO_PROPOSER,
+PROPOSER_TO_ACCEPTOR] ride the log as a single value
+(ref member/paxos.cpp:650-657), every node applies changes when its
+own learner frontier reaches them (ref member/paxos.cpp:1862-1964
+ChangeMemberships), acceptor-set changes bump a Version that gates
+all prepare/accept processing (ref member/paxos.cpp:1702, 1747), and
+a change is "Applied" once a majority of the current acceptors have
+learned it (ref member/paxos.cpp:1716-1733 OnLearnReply) — the
+sequencing point the churn harness waits on
+(ref member/main.cpp:138-140).
+
+Here the cluster state is node-axis boolean role masks per *viewing
+node* (each node has its own view, updated at its own apply
+frontier), versions are per-node ints, and the protocol runs as a
+synchronous bulk round loop — faithful to member/'s network, which
+delivers synchronously by calling the peer's OnReceive directly
+(ref member/main.cpp:65-79).
+"""
+
+from tpu_paxos.membership.engine import (
+    ADD_ACCEPTOR,
+    DEL_ACCEPTOR,
+    MemberSim,
+    change_vid,
+    decode_change,
+    is_change_vid,
+    membership_suffix,
+)
+
+__all__ = [
+    "ADD_ACCEPTOR",
+    "DEL_ACCEPTOR",
+    "MemberSim",
+    "change_vid",
+    "decode_change",
+    "is_change_vid",
+    "membership_suffix",
+]

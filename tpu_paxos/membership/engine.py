@@ -1,0 +1,582 @@
+"""Synchronous-round membership engine (member/ parity).
+
+One loop iteration = one synchronous message exchange — faithful to
+member/'s network, which delivers by calling the peer's ``OnReceive``
+inline (ref member/main.cpp:65-79).  There are no drops or delays in
+this variant (ref member/debug.conf.sample: failure_rate 0); liveness
+needs only the anti-dueling prepare backoff and an accept-staleness
+restart (covering version races, ref Proposer::AcceptorsChanged
+member/paxos.cpp:1862-1908).
+
+Cluster bootstrap: every node's view starts as {0} in all three role
+sets (ref NodeImpl::Loop, member/paxos.cpp:729-737: only node ``first_``
+exists; only it instantiates Proposer+Acceptor).  All growth happens
+through the log.
+
+Membership-change values: one log entry carries a whole change vector
+(ref ProposedValue(changes, cb), member/paxos.cpp:650-657) — encoded
+here as a single vid >= CHANGE_BASE with a (target node, kind) pair,
+where composite kinds expand to the reference's vectors:
+ADD_ACCEPTOR -> [ADD_LEARNER, LEARNER_TO_PROPOSER,
+PROPOSER_TO_ACCEPTOR], DEL_ACCEPTOR -> [ACCEPTOR_TO_PROPOSER,
+PROPOSER_TO_LEARNER, DEL_LEARNER].
+
+Version gating: prepare and accept messages carry the sender's
+version and acceptors drop them unless it equals their own
+(ref member/paxos.cpp:1702, 1747); each acceptor-set change bumps the
+applying node's version by one (ref member/paxos.cpp:1897, 1951), so
+two nodes agree on version iff they have applied the same number of
+acceptor changes — i.e. the gate enforces same-view quorums.
+
+Applied semantics: a chosen value is *Applied* once a majority of the
+(current-view) acceptors have learned it
+(ref Proposer::OnLearnReply, member/paxos.cpp:1716-1733); the churn
+driver waits for Applied before issuing the next change
+(ref member/main.cpp:138-140) — ``MemberSim.applied`` exposes exactly
+this predicate.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import values as val
+from tpu_paxos.utils import prng
+
+# Change kinds (ref member/paxos.cpp:61-69 enum MembershipChangeType)
+ADD_LEARNER = 0
+LEARNER_TO_PROPOSER = 1
+PROPOSER_TO_ACCEPTOR = 2
+DEL_LEARNER = 3
+PROPOSER_TO_LEARNER = 4
+ACCEPTOR_TO_PROPOSER = 5
+# Composites (one log entry each, ref member/paxos.cpp:650-657, 706-714)
+ADD_ACCEPTOR = 6
+DEL_ACCEPTOR = 7
+
+CHANGE_BASE = 2**28
+COMMITTED_BALLOT = jnp.int32(2**30)
+_NEG = jnp.int32(jnp.iinfo(jnp.int32).min)
+
+ACCEPT_STALE_ROUNDS = 4  # restart prepare if a batch stalls this long
+
+
+def change_vid(node: int, kind: int) -> int:
+    """Encode a membership change as a value id."""
+    return CHANGE_BASE + node * 8 + kind
+
+
+def is_change_vid(vid) -> bool:
+    return np.asarray(vid) >= CHANGE_BASE
+
+
+def decode_change(vid: int) -> tuple[int, int]:
+    """-> (target node, kind)."""
+    k = int(vid) - CHANGE_BASE
+    return k // 8, k % 8
+
+
+def membership_suffix(vid: int) -> str | None:
+    """Decision-log suffix in the reference grammar
+    (ref multi/paxos.cpp:20-22): ``m+id=ip:port`` for additive
+    changes, ``m-id`` for removals; None for non-change vids.  Node
+    addresses are synthetic, as in the reference harness where the
+    port is just the peer index (ref multi/main.cpp:265-268)."""
+    if vid < CHANGE_BASE:
+        return None
+    node, kind = decode_change(vid)
+    additive = kind in (
+        ADD_LEARNER,
+        LEARNER_TO_PROPOSER,
+        PROPOSER_TO_ACCEPTOR,
+        ADD_ACCEPTOR,
+    )
+    return f"m+{node}=node:{node}" if additive else f"m-{node}"
+
+
+class MemberState(NamedTuple):
+    t: jax.Array
+    # per-viewing-node role masks: row v = node v's view
+    learners: jax.Array  # [N, N] bool
+    proposers: jax.Array  # [N, N] bool
+    acceptors: jax.Array  # [N, N] bool
+    version: jax.Array  # [N] int32
+    # acceptor state
+    promised: jax.Array  # [N] int32
+    max_seen: jax.Array  # [N] int32
+    acc_ballot: jax.Array  # [I, N] int32
+    acc_vid: jax.Array  # [I, N] int32
+    # learner state
+    learned: jax.Array  # [I, N] int32
+    applied_upto: jax.Array  # [N] int32 apply frontier
+    # proposer state
+    count: jax.Array  # [N] int32
+    ballot: jax.Array  # [N] int32
+    pmax: jax.Array  # [N] int32 max ballot seen via rejects
+    prepared: jax.Array  # [N] bool
+    delay_until: jax.Array  # [N] int32 prepare backoff
+    adopted_b: jax.Array  # [N, I] int32
+    adopted_v: jax.Array  # [N, I] int32
+    cur_batch: jax.Array  # [N, I] int32
+    acks: jax.Array  # [N, I, N] bool
+    batch_age: jax.Array  # [N] int32 rounds since batch progress
+    own_assign: jax.Array  # [N, I] int32
+    pend: jax.Array  # [N, C] int32
+    head: jax.Array  # [N] int32
+    tail: jax.Array  # [N] int32
+    # decisions
+    chosen_vid: jax.Array  # [I] int32
+    chosen_round: jax.Array  # [I] int32
+    chosen_ballot: jax.Array  # [I] int32
+
+
+def _init(n: int, i: int, c: int) -> MemberState:
+    none = lambda *sh: jnp.full(sh, bal.NONE, jnp.int32)  # noqa: E731
+    zero = lambda *sh: jnp.zeros(sh, jnp.int32)  # noqa: E731
+    seed_view = jnp.zeros((n, n), jnp.bool_).at[:, 0].set(True)
+    return MemberState(
+        t=jnp.int32(0),
+        learners=seed_view,
+        proposers=seed_view,
+        acceptors=seed_view,
+        version=zero(n),
+        promised=zero(n),
+        max_seen=zero(n),
+        acc_ballot=none(i, n),
+        acc_vid=none(i, n),
+        learned=none(i, n),
+        applied_upto=zero(n),
+        count=zero(n),
+        ballot=zero(n),
+        pmax=zero(n),
+        prepared=jnp.zeros((n,), jnp.bool_),
+        delay_until=zero(n),
+        adopted_b=none(n, i),
+        adopted_v=none(n, i),
+        cur_batch=none(n, i),
+        acks=jnp.zeros((n, i, n), jnp.bool_),
+        batch_age=zero(n),
+        own_assign=none(n, i),
+        pend=none(n, c),
+        head=zero(n),
+        tail=zero(n),
+        chosen_vid=none(i),
+        chosen_round=none(i),
+        chosen_ballot=none(i),
+    )
+
+
+def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
+    idx = jnp.arange(i_cap, dtype=jnp.int32)
+    rows = jnp.arange(n)
+
+    def round_fn(st: MemberState) -> MemberState:
+        t = st.t
+        # node-local roles (a node acts on its OWN view of itself)
+        is_prop = st.proposers[rows, rows]  # [N]
+        is_accp = st.acceptors[rows, rows]  # [N]
+        quorum_v = (
+            jnp.sum(st.acceptors, axis=1, dtype=jnp.int32) // 2 + 1
+        )  # [N] majority of each node's view
+
+        # ---------- ACCEPT phase (batches from previously prepared) ----
+        send_acc = st.prepared & jnp.any(st.cur_batch != val.NONE, axis=1)
+        # version gate: acceptor a processes proposer v iff equal
+        # versions (ref member/paxos.cpp:1747) and a is an acceptor in
+        # v's view and its own
+        edge = (
+            send_acc[:, None]
+            & st.acceptors[:, :]  # v targets its view's acceptors
+            & is_accp[None, :]
+            & (st.version[:, None] == st.version[None, :])
+        )  # [V, A]
+        elig = edge & (st.ballot[:, None] >= st.promised[None, :])
+        max_seen = jnp.maximum(
+            st.max_seen,
+            jnp.max(jnp.where(edge, st.ballot[:, None], bal.NONE), axis=0),
+        )
+        is_comm = st.learned != val.NONE  # [I, A]
+        w_has = st.cur_batch != val.NONE  # [V, I]
+        ack = (
+            elig[:, None, :]
+            & w_has[:, :, None]
+            & jnp.where(
+                is_comm[None],
+                st.cur_batch[:, :, None] == st.learned[None],
+                st.ballot[:, None, None] >= st.acc_ballot[None],
+            )
+        )  # [V, I, A]
+        cand = jnp.where(
+            ack & ~is_comm[None], st.ballot[:, None, None], bal.NONE
+        )
+        best_b = jnp.max(cand, axis=0)  # [I, A]
+        best_v = jnp.argmax(cand, axis=0)
+        sel = rows[:, None, None] == best_v[None]
+        store_v = jnp.max(
+            jnp.where(sel, st.cur_batch[:, :, None], _NEG), axis=0
+        )
+        do_store = best_b != bal.NONE
+        acc_ballot = jnp.where(do_store, best_b, st.acc_ballot)
+        acc_vid = jnp.where(do_store, store_v, st.acc_vid)
+        # rejects flow back synchronously
+        rejed = edge & ~elig
+        pmax = jnp.maximum(
+            st.pmax, jnp.max(jnp.where(rejed.T, max_seen[:, None], bal.NONE).T, axis=1),
+        )
+
+        # per-instance quorum over the proposer's view acceptors
+        acks = st.acks | ack
+        n_ack = jnp.sum(
+            acks & st.acceptors[:, None, :], axis=-1, dtype=jnp.int32
+        )  # [V, I]
+        inst_chosen = w_has & (n_ack >= quorum_v[:, None])
+        newly = inst_chosen & (st.chosen_vid[None] == val.NONE)
+        any_new = jnp.any(newly, axis=0)
+        new_v = jnp.max(jnp.where(newly, st.cur_batch, _NEG), axis=0)
+        new_b = jnp.max(jnp.where(newly, st.ballot[:, None], _NEG), axis=0)
+        chosen_vid = jnp.where(any_new, new_v, st.chosen_vid)
+        chosen_round = jnp.where(any_new, t, st.chosen_round)
+        chosen_ballot = jnp.where(any_new, new_b, st.chosen_ballot)
+
+        # LEARN broadcast (synchronous, to the chooser's view-learners;
+        # ref Learner::OnLearn) — chosen values reach every listed
+        # learner this round
+        learn_edge = inst_chosen[:, :, None] & st.learners[:, None, :]
+        has_l = jnp.any(learn_edge, axis=0)  # [I, L]
+        lv = jnp.max(jnp.where(learn_edge, st.cur_batch[:, :, None], _NEG), axis=0)
+        learned = jnp.where(has_l & (st.learned == val.NONE), lv, st.learned)
+
+        # anti-entropy pull at each node's first learned-gap (the
+        # reference's learner-side Learn retry for unlearned instances,
+        # ref member/paxos.cpp:1029-1073): one instance per round.
+        # Node nn may pull from any donor m that has it and whose view
+        # lists nn as a learner (st.learners[m, nn]).
+        f = jnp.clip(
+            jnp.sum(
+                jnp.cumprod((learned.T != val.NONE).astype(jnp.int32), axis=1),
+                axis=1,
+            ),
+            0,
+            i_cap - 1,
+        )  # [N]
+        mine = learned[f, rows]  # [N] nn's own copy at its frontier
+        l_at_f = learned[f, :]  # [N, M] row nn = all holders of f[nn]
+        donor_ok = (l_at_f != val.NONE) & st.learners.T  # [nn, m]
+        can_pull = jnp.any(donor_ok, axis=1) & (mine == val.NONE)
+        pulled = jnp.max(jnp.where(donor_ok, l_at_f, _NEG), axis=1)
+        learned = learned.at[f, rows].set(
+            jnp.where(can_pull, pulled, mine)
+        )
+
+        # ---------- apply frontier ----------
+        # Plain values batch-apply (the frontier jumps over the whole
+        # learned run, ref Learner::Apply walks while next is learned,
+        # member/paxos.cpp:1029-1060); membership changes apply at
+        # most one per node per round (each mutates the view the next
+        # entries are interpreted under).
+        fa = st.applied_upto  # [N]
+        lme = learned.T  # [N, I]
+        app = lme != val.NONE
+        nonchg = app & (lme < CHANGE_BASE)
+        pre = idx[None] < fa[:, None]
+        run_total = jnp.sum(
+            jnp.cumprod((nonchg | pre).astype(jnp.int32), axis=1), axis=1
+        )
+        run = jnp.maximum(run_total - fa, 0)  # plain values applied now
+        f2 = jnp.clip(fa + run, 0, i_cap - 1)
+        head_v = learned[f2, rows]  # [N] entry right after the run
+        can_apply = (
+            (head_v != val.NONE) & (fa + run < i_cap) & (head_v >= CHANGE_BASE)
+        )
+        is_chg = can_apply
+        k = jnp.where(is_chg, head_v - CHANGE_BASE, 0)
+        tgt = k // 8
+        kind = k % 8
+        addl = is_chg & ((kind == ADD_LEARNER) | (kind == ADD_ACCEPTOR))
+        dell = is_chg & ((kind == DEL_LEARNER) | (kind == DEL_ACCEPTOR))
+        addp = is_chg & (
+            (kind == LEARNER_TO_PROPOSER) | (kind == ADD_ACCEPTOR)
+        )
+        delp = is_chg & (
+            (kind == PROPOSER_TO_LEARNER) | (kind == DEL_ACCEPTOR)
+        )
+        adda = is_chg & (
+            (kind == PROPOSER_TO_ACCEPTOR) | (kind == ADD_ACCEPTOR)
+        )
+        dela = is_chg & (
+            (kind == ACCEPTOR_TO_PROPOSER) | (kind == DEL_ACCEPTOR)
+        )
+        cur_l = st.learners[rows, tgt]
+        learners_v = st.learners.at[rows, tgt].set(
+            jnp.where(addl, True, jnp.where(dell, False, cur_l))
+        )
+        cur_p = st.proposers[rows, tgt]
+        proposers_v = st.proposers.at[rows, tgt].set(
+            jnp.where(addp, True, jnp.where(delp, False, cur_p))
+        )
+        cur_a = st.acceptors[rows, tgt]
+        acceptors_v = st.acceptors.at[rows, tgt].set(
+            jnp.where(adda, True, jnp.where(dela, False, cur_a))
+        )
+        acc_changed = adda | dela
+        version = st.version + acc_changed.astype(jnp.int32)
+        applied_upto = fa + run + can_apply.astype(jnp.int32)
+        # AcceptorsChanged -> proposer restarts its prepare
+        # (ref member/paxos.cpp:1895-1908)
+        prepared = st.prepared & ~acc_changed
+
+        # batch staleness: no progress for too long -> restart prepare
+        progress = jnp.any(newly, axis=1)
+        outstanding = jnp.any(
+            (st.cur_batch != val.NONE)
+            & (chosen_vid[None] == val.NONE),
+            axis=1,
+        )
+        batch_age = jnp.where(
+            progress | ~outstanding, 0, st.batch_age + 1
+        )
+        stale = outstanding & (batch_age >= ACCEPT_STALE_ROUNDS)
+        prepared = prepared & ~stale
+        kd = prng.stream(root, prng.STREAM_PREPARE_DELAY, t)
+        backoff = jax.random.randint(kd, (n,), 0, 4, dtype=jnp.int32)
+        delay_until = jnp.where(stale, t + 1 + backoff, st.delay_until)
+        batch_age = jnp.where(stale, 0, batch_age)
+
+        # conflict re-proposal / own completion (ref OnLearn conflict
+        # path; same semantics as core/sim)
+        learned_me = learned.T  # [N, I] each node's own learner column
+        own_has = st.own_assign != val.NONE
+        conflict = own_has & (learned_me != val.NONE) & (
+            learned_me != st.own_assign
+        )
+        own_done = own_has & (learned_me == st.own_assign)
+        nreq = jnp.sum(conflict, axis=1, dtype=jnp.int32)
+        rr = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
+        req_pos = jnp.where(conflict, st.tail[:, None] + rr, c)
+        pend = st.pend.at[rows[:, None], req_pos].set(
+            st.own_assign, mode="drop"
+        )
+        tail = st.tail + nreq
+        own_assign = jnp.where(conflict | own_done, val.NONE, st.own_assign)
+
+        # drop chosen instances from batches (quiesce bookkeeping)
+        cur_batch = jnp.where(
+            chosen_vid[None] != val.NONE, val.NONE, st.cur_batch
+        )
+        cur_batch = jnp.where(prepared[:, None], cur_batch, val.NONE)
+        acks = jnp.where(prepared[:, None, None], acks, False)
+
+        # ---------- PREPARE phase ----------
+        committed_me = learned_me != val.NONE  # [N, I]
+        has_work = (st.head < tail) | jnp.any(own_assign != val.NONE, axis=1)
+        want_prep = (
+            is_prop & ~prepared & has_work & (t >= delay_until)
+        )
+        ncnt, nbal = bal.bump_past(
+            st.count, rows.astype(jnp.int32), jnp.maximum(pmax, st.ballot)
+        )
+        count = jnp.where(want_prep, ncnt, st.count)
+        ballot = jnp.where(want_prep, nbal, st.ballot)
+        pedge = (
+            want_prep[:, None]
+            & acceptors_v
+            & is_accp[None, :]
+            & (version[:, None] == version[None, :])
+        )
+        grant = pedge & (ballot[:, None] > st.promised[None, :])
+        promised = jnp.maximum(
+            st.promised, jnp.max(jnp.where(grant, ballot[:, None], bal.NONE), axis=0)
+        )
+        max_seen = jnp.maximum(
+            max_seen, jnp.max(jnp.where(pedge, ballot[:, None], bal.NONE), axis=0)
+        )
+        pmax = jnp.maximum(
+            pmax,
+            jnp.max(
+                jnp.where((pedge & ~grant).T, max_seen[:, None], bal.NONE).T,
+                axis=1,
+            ),
+        )
+        # synchronous promise + snapshot reply (committed values at the
+        # sentinel ballot; snap_b [I, A] broadcast over proposers V)
+        snap_b = jnp.where(learned != val.NONE, COMMITTED_BALLOT, acc_ballot)
+        snap_v = jnp.where(learned != val.NONE, learned, acc_vid)
+        repb = jnp.where(
+            grant[:, None, :],
+            jnp.broadcast_to(snap_b[None], (n, i_cap, n)),
+            bal.NONE,
+        )
+        best_ab = jnp.max(repb, axis=-1)  # [V, I]
+        best_aa = jnp.argmax(repb, axis=-1)
+        best_av = jnp.take_along_axis(
+            jnp.broadcast_to(snap_v[None], (n, i_cap, n)), best_aa[..., None], axis=-1
+        )[..., 0]
+        n_prom = jnp.sum(grant & acceptors_v, axis=1, dtype=jnp.int32)
+        now_prep = want_prep & (n_prom >= quorum_v)
+        adopted_b = jnp.where(now_prep[:, None], jnp.where(best_ab > 0, best_ab, bal.NONE), bal.NONE)
+        adopted_v = jnp.where(
+            now_prep[:, None] & (best_ab > 0), best_av, val.NONE
+        )
+        prepared = prepared | now_prep
+        delay_until = jnp.where(
+            want_prep & ~now_prep, t + 1 + backoff, delay_until
+        )
+
+        # batch skeleton for the newly prepared: adopted + noop holes
+        use_adopt = ~committed_me & (adopted_b != bal.NONE)
+        covered0 = committed_me | use_adopt
+        hi = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)
+        below = idx[None] <= hi[:, None]
+        noop_fill = below & ~covered0
+        use_own = ~below & (own_assign != val.NONE)
+        batch0 = jnp.where(
+            use_adopt,
+            adopted_v,
+            jnp.where(
+                noop_fill,
+                val.noop_vid(idx[None], rows[:, None], i_cap),
+                jnp.where(use_own, own_assign, val.NONE),
+            ),
+        )
+        batch0 = jnp.where(committed_me, val.NONE, batch0)
+        cur_batch = jnp.where(now_prep[:, None], batch0, cur_batch)
+        acks = jnp.where(now_prep[:, None, None], False, acks)
+        batch_age = jnp.where(now_prep, 0, batch_age)
+
+        # new-value assignment for prepared proposers (first-fit over
+        # the open tail; same shape as core/sim but ungated)
+        can_assign = prepared
+        activity = (
+            committed_me | (cur_batch != val.NONE) | (own_assign != val.NONE)
+        )
+        hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)
+        free = idx[None] > hi2[:, None]
+        qn = jnp.minimum(tail - st.head, jnp.int32(i_cap))
+        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+        kk = jnp.minimum(qn, jnp.sum(free, axis=1, dtype=jnp.int32))
+        kk = jnp.where(can_assign, kk, 0)
+        takev = free & (free_rank < kk[:, None])
+        qpos = jnp.clip(st.head[:, None] + free_rank, 0, c - 1)
+        newv = jnp.take_along_axis(pend, qpos, axis=1)
+        cur_batch = jnp.where(takev, newv, cur_batch)
+        own_assign = jnp.where(takev, newv, own_assign)
+        head = st.head + kk
+
+        return MemberState(
+            t=t + 1,
+            learners=learners_v,
+            proposers=proposers_v,
+            acceptors=acceptors_v,
+            version=version,
+            promised=promised,
+            max_seen=max_seen,
+            acc_ballot=acc_ballot,
+            acc_vid=acc_vid,
+            learned=learned,
+            applied_upto=applied_upto,
+            count=count,
+            ballot=ballot,
+            pmax=pmax,
+            prepared=prepared,
+            delay_until=delay_until,
+            adopted_b=adopted_b,
+            adopted_v=adopted_v,
+            cur_batch=cur_batch,
+            acks=acks,
+            batch_age=batch_age,
+            own_assign=own_assign,
+            pend=pend,
+            head=head,
+            tail=tail,
+            chosen_vid=chosen_vid,
+            chosen_round=chosen_round,
+            chosen_ballot=chosen_ballot,
+        )
+
+    return round_fn
+
+
+class MemberSim:
+    """Host driver around the synchronous membership engine — plays
+    the role of member/main.cpp: injects proposals and membership
+    changes, steps the engine, exposes the Applied predicate and the
+    per-node applied logs."""
+
+    def __init__(self, n_nodes: int, n_instances: int, seed: int = 0):
+        self.n = n_nodes
+        self.i = n_instances
+        self.c = n_instances * 2 + 8
+        self.root = prng.root_key(seed)
+        self.state = _init(n_nodes, n_instances, self.c)
+        self._round = jax.jit(_build_round(n_nodes, n_instances, self.c, self.root))
+
+    # -- injection (between rounds, host-side; the reference's
+    # Node::Propose / AddAcceptor / DelAcceptor surface) --
+    def propose(self, node: int, vid: int) -> None:
+        st = self.state
+        pos = int(st.tail[node])
+        if pos >= self.c:
+            raise RuntimeError("pending queue overflow")
+        self.state = st._replace(
+            pend=st.pend.at[node, pos].set(vid),
+            tail=st.tail.at[node].add(1),
+        )
+
+    def add_acceptor(self, target: int, via: int = 0) -> int:
+        vid = change_vid(target, ADD_ACCEPTOR)
+        self.propose(via, vid)
+        return vid
+
+    def del_acceptor(self, target: int, via: int = 0) -> int:
+        vid = change_vid(target, DEL_ACCEPTOR)
+        self.propose(via, vid)
+        return vid
+
+    # -- stepping --
+    def run_rounds(self, k: int) -> None:
+        for _ in range(k):
+            self.state = self._round(self.state)
+
+    def run_until(self, pred, max_rounds: int = 2000, step: int = 4) -> bool:
+        for _ in range(0, max_rounds, step):
+            if pred():
+                return True
+            self.run_rounds(step)
+        return pred()
+
+    # -- predicates / views --
+    def chosen(self, vid: int) -> bool:
+        return bool(np.any(np.asarray(self.state.chosen_vid) == vid))
+
+    def applied(self, vid: int, viewer: int = 0) -> bool:
+        """Applied = a majority of the viewer's current acceptors have
+        learned the value (ref member/paxos.cpp:1716-1733)."""
+        st = self.state
+        cv = np.asarray(st.chosen_vid)
+        where = np.flatnonzero(cv == vid)
+        if not where.size:
+            return False
+        i = int(where[0])
+        acc = np.asarray(st.acceptors[viewer])
+        learned = np.asarray(st.learned[i]) != int(val.NONE)
+        return int((acc & learned).sum()) >= int(acc.sum()) // 2 + 1
+
+    def applied_log(self, node: int) -> np.ndarray:
+        """Real (non-noop, non-change) values node has applied, in
+        order — what the reference's checking StateMachine collects
+        (ref member/main.cpp:223-233)."""
+        st = self.state
+        upto = int(st.applied_upto[node])
+        col = np.asarray(st.learned[:upto, node])
+        return col[(col >= 0) & (col < CHANGE_BASE)]
+
+    def acceptor_set(self, viewer: int = 0) -> set[int]:
+        return set(np.flatnonzero(np.asarray(self.state.acceptors[viewer])).tolist())
+
+    def learner_set(self, viewer: int = 0) -> set[int]:
+        return set(np.flatnonzero(np.asarray(self.state.learners[viewer])).tolist())

@@ -1,0 +1,17 @@
+"""Determinism layer: decision logs + byte-identical replay.
+
+The reference's signature capability is deterministic record/replay of
+a whole multithreaded run (``Indet``, ref member/indet.h:182-194,
+member/run.sh:1-18: run, re-run in replay mode, ``diff`` the logs —
+byte-identical output is the pass criterion).  In this framework the
+entire schedule is already a pure function of (config, seed): the
+engine's randomness is counter-based ``jax.random`` keyed on
+(seed, stream, round), so *replay is re-execution*.  What this package
+provides is the observable artifact: the decision log in the
+reference's grammar, so two same-seed runs can be byte-compared the
+way ``member/diff.sh`` compares record and replay logs.
+"""
+
+from tpu_paxos.replay.decision_log import decision_log
+
+__all__ = ["decision_log"]

@@ -1,0 +1,65 @@
+"""Decision-log emitter in the reference's debug value grammar.
+
+The grammar (ref multi/paxos.cpp:18-22):
+
+    no-op:      [instance-id] = <proposal-id>(proposer:value-id)-
+    normal:     [instance-id] = <proposal-id>(proposer:value-id)+value
+    add member: [instance-id] = <proposal-id>(proposer:value-id)m+id=ip:port
+    del member: [instance-id] = <proposal-id>(proposer:value-id)m-id
+
+One line per decided instance, in instance order; the log is a pure
+function of the engine result, so two same-seed runs emit
+byte-identical logs (the replay-diff test, spirit of
+ref member/diff.sh:1-3).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from tpu_paxos.core import values as val
+
+
+def decision_log(
+    chosen_vid: np.ndarray,
+    chosen_ballot: np.ndarray,
+    stride: int,
+    n_instances: int,
+    payload: Callable[[int], str] | None = None,
+    membership: Callable[[int], str] | None = None,
+) -> str:
+    """Render the decided log.
+
+    ``stride`` is the workload's vid stride (canonical encoding
+    ``vid = proposer * stride + seq``, core/values.py).  ``payload``
+    optionally maps a real vid to its payload string (defaults to the
+    vid's decimal value-id — the reference harness's values are small
+    ints too, ref multi/main.cpp:202-219).  ``membership`` maps a
+    membership-change vid to its ``m+id=ip:port`` / ``m-id`` suffix
+    (membership/ provides one); vids it returns None for fall through
+    to the normal grammar.
+    """
+    chosen_vid = np.asarray(chosen_vid)
+    chosen_ballot = np.asarray(chosen_ballot)
+    lines = []
+    for i in range(len(chosen_vid)):
+        v = int(chosen_vid[i])
+        if v == int(val.NONE):
+            continue
+        b = int(chosen_ballot[i])
+        if v <= val.NOOP_BASE:
+            proposer, inst, _ = val.decode_host(v, stride, n_instances)
+            lines.append(f"[{i}] = <{b}>({proposer}:{inst})-")
+            continue
+        if membership is not None:
+            m = membership(v)
+            if m is not None:
+                proposer, seq, _ = val.decode_host(v, stride, n_instances)
+                lines.append(f"[{i}] = <{b}>({proposer}:{seq}){m}")
+                continue
+        proposer, seq, _ = val.decode_host(v, stride, n_instances)
+        body = payload(v) if payload is not None else str(seq)
+        lines.append(f"[{i}] = <{b}>({proposer}:{seq})+{body}")
+    return "\n".join(lines) + ("\n" if lines else "")
