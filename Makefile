@@ -1,0 +1,40 @@
+# Analog of the reference's shell-script surface (ref multi/run.sh,
+# multi/val.sh, member/diff.sh): run, bench, parity-vs-C++, replay-diff.
+
+PY ?= python
+
+.PHONY: test bench bench-sharded parity parity-fast replay-diff run clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+bench-sharded:
+	TPU_PAXOS_BENCH_SHARDED=1 $(PY) bench.py
+
+# Full-speed parity anchor: the canonical debug.conf.sample line on the
+# C++ reference (~50s wall clock — its delays are real milliseconds),
+# then the tpu_paxos equivalent, both judged by the same invariants.
+parity:
+	$(PY) -c "import json; from tpu_paxos.harness import reference_runner as r; \
+	print(json.dumps(r.check_parity(reference_args_list=r.reference_args(), timeout=600), indent=2))"
+
+# Time-scaled parity anchor (seconds instead of ~50s; fault rates identical).
+parity-fast:
+	$(PY) -c "import json; from tpu_paxos.harness import reference_runner as r; \
+	print(json.dumps(r.check_parity(), indent=2))"
+
+# Same-seed reruns produce byte-identical decision logs (spirit of
+# ref member/diff.sh).
+replay-diff:
+	$(PY) -m pytest tests/test_replay.py -x -q
+
+# The debug.conf.sample workload end-to-end on the tpu engine.
+run:
+	$(PY) -m tpu_paxos 4 4 10 --seed=0 --net-drop-rate=500 \
+	  --net-dup-rate=1000 --net-min-delay=0 --net-max-delay=2
+
+clean:
+	rm -rf build

@@ -1,0 +1,116 @@
+"""Adversarial pin of the calendar-coalescing argument (core/net.py).
+
+The coalescing model claims: when two in-flight copies land on the
+same (edge, type) slot, the higher-ballot / newer one wins, and every
+such artifact is equivalent to a legal drop of the older copy in the
+reference network (ref THNetWork delivers both, but the acceptor
+processes the older one first or second with the same outcome — the
+newer ballot governs, multi/paxos.cpp:1366).  These tests construct
+the adversarial case deliberately: a *delayed duplicate of an older
+accept* colliding with a newer accept on one edge, in both arrival
+orders."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import net as netm
+from tpu_paxos.core import values as val
+
+S, P, A, I = 6, 1, 3, 4
+
+
+def _plan(delay: int, edge_shape):
+    """A fault plan with exactly the original copy alive at ``delay``."""
+    alive = np.zeros((netm.MAX_COPIES, *edge_shape), bool)
+    alive[0] = True
+    delays = np.full((netm.MAX_COPIES, *edge_shape), delay, np.int32)
+    return jnp.asarray(alive), jnp.asarray(delays)
+
+
+def _send_accept(net, t, delay, ballot, batch):
+    al, dl = _plan(delay, (P, A))
+    send = jnp.ones((P,), bool)
+    net = net._replace(
+        acc_req=netm.write_ballot(
+            net.acc_req, t, al, dl, jnp.full((P, A), ballot, jnp.int32),
+            send[:, None],
+        )
+    )
+    nb, nbb = netm.write_content(
+        net.acc_bat, net.acc_bat_ballot, t, al, dl,
+        jnp.asarray(batch, jnp.int32).reshape(P, I),
+        jnp.full((P,), ballot, jnp.int32), send,
+    )
+    return net._replace(acc_bat=nb, acc_bat_ballot=nbb)
+
+
+def test_delayed_old_dup_collides_with_newer_accept_old_first():
+    """Old accept (ballot b1, batch X) sent at t=0 with delay 2; newer
+    accept (b2 > b1, batch Y) sent at t=1 with delay 1.  Both land in
+    arrival round 3.  The newer must win both the per-edge ballot and
+    the batch content."""
+    b1 = int(bal.make(1, 0))
+    b2 = int(bal.make(2, 0))
+    old_batch = [100, 101, val.NONE, val.NONE]
+    new_batch = [200, 201, 202, val.NONE]
+    net = netm.init_buffers(S, P, A, I)
+    net = _send_accept(net, jnp.int32(0), 2, b1, old_batch)  # arrives r3
+    net = _send_accept(net, jnp.int32(1), 1, b2, new_batch)  # arrives r3
+    slot = 3 % S
+    assert int(net.acc_req[slot, 0, 0]) == b2
+    assert int(net.acc_bat_ballot[slot, 0]) == b2
+    np.testing.assert_array_equal(np.asarray(net.acc_bat[slot, 0]), new_batch)
+
+
+def test_delayed_old_dup_collides_with_newer_accept_new_first():
+    """Same collision with write order reversed (the duplicate's
+    calendar write happens after the newer message's): the stored
+    newer content must NOT be downgraded."""
+    b1 = int(bal.make(1, 0))
+    b2 = int(bal.make(2, 0))
+    old_batch = [100, 101, val.NONE, val.NONE]
+    new_batch = [200, 201, 202, val.NONE]
+    net = netm.init_buffers(S, P, A, I)
+    net = _send_accept(net, jnp.int32(1), 1, b2, new_batch)  # arrives r3
+    net = _send_accept(net, jnp.int32(0), 2, b1, old_batch)  # arrives r3
+    slot = 3 % S
+    assert int(net.acc_req[slot, 0, 0]) == b2
+    assert int(net.acc_bat_ballot[slot, 0]) == b2
+    np.testing.assert_array_equal(np.asarray(net.acc_bat[slot, 0]), new_batch)
+
+
+def test_equal_ballot_batches_merge_union():
+    """Two same-ballot accept batches covering disjoint instances (one
+    proposer's successive sends) merge by union — neither clobbers the
+    other's instances to NONE."""
+    b = int(bal.make(3, 0))
+    first = [300, val.NONE, val.NONE, val.NONE]
+    second = [val.NONE, 301, val.NONE, val.NONE]
+    net = netm.init_buffers(S, P, A, I)
+    net = _send_accept(net, jnp.int32(0), 2, b, first)
+    net = _send_accept(net, jnp.int32(1), 1, b, second)
+    slot = 3 % S
+    got = np.asarray(net.acc_bat[slot, 0])
+    assert got[0] == 300 and got[1] == 301
+
+
+def test_engine_safety_under_forced_collisions():
+    """Whole-engine adversarial run: heavy dup + delay makes same-slot
+    collisions of old and new accepts routine; safety (agreement,
+    exactly-once) must hold and the run must quiesce."""
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.core import sim
+    from tpu_paxos.harness import validate
+
+    cfg = SimConfig(
+        n_nodes=3,
+        n_instances=24,
+        proposers=(0, 1),
+        seed=3,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=500, dup_rate=5000, min_delay=0, max_delay=4),
+    )
+    r = sim.run(cfg)
+    assert r.done, f"did not quiesce in {r.rounds} rounds"
+    validate.check_all(r.learned, r.expected_vids)

@@ -1,0 +1,110 @@
+"""Decision-parity anchor vs the C++ reference binary (BASELINE config 1).
+
+Builds the reference with its own Makefile recipe, runs the debug.conf
+workload (time-scaled; fault rates untouched), parses the committed-log
+grammar (ref multi/paxos.cpp:18-22), and asserts the reference's own
+end-of-run invariants (ref multi/main.cpp:566-573) on BOTH the C++ run
+and a tpu_paxos run of the equivalent config — the same external
+checker judges both systems.  ``make parity`` runs the full-speed
+canonical config end to end.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from tpu_paxos.harness import reference_runner as ref
+from tpu_paxos.harness import validate
+
+_HAVE_REF = os.path.isdir(ref.REFERENCE_DIR) and shutil.which("g++")
+
+pytestmark = pytest.mark.skipif(
+    not _HAVE_REF, reason="reference sources or g++ unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def reference_run() -> ref.ReferenceRun:
+    """One shared fast-config reference run (seed 0)."""
+    return ref.run_reference(ref.fast_reference_args(seed=0), timeout=300)
+
+
+def test_reference_builds_and_passes_own_asserts(reference_run):
+    # rc=0 + "All done" = every inline ASSERT and the epilogue checks
+    # passed inside the binary (ref multi/main.cpp:566-579).
+    assert reference_run.returncode == 0
+    assert reference_run.all_done
+
+
+def test_reference_log_parses_in_grammar(reference_run):
+    logs = reference_run.logs
+    assert set(logs.keys()) == {0, 1, 2, 3}
+    for s, entries in logs.items():
+        assert entries, f"server {s} dumped no committed values"
+        for e in entries:
+            assert e.ballot > 0
+            assert 0 <= e.proposer < 4
+            if not e.noop:
+                assert 0 <= int(e.value) < 40
+
+
+def test_reference_invariants_rederived(reference_run):
+    # Independent re-check of agreement / exactly-once / in-order on
+    # the parsed dump — not trusting the binary's own asserts.
+    ref.check_reference_invariants(reference_run, srvcnt=4, cltcnt=4, idcnt=10)
+
+
+def test_equivalent_sim_same_invariants():
+    res, in_order = ref.run_equivalent_sim(
+        srvcnt=4, cltcnt=4, idcnt=10, seed=0
+    )
+    assert res.done, f"did not quiesce in {res.rounds} rounds"
+    seqs = validate.check_all(res.learned, res.expected_vids)
+    validate.check_in_order_clients(seqs[0], in_order)
+
+
+def test_parity_anchor(reference_run):
+    """Both systems, same config shape, same checker: BASELINE's
+    'decision parity vs the C++ multi/ binary'."""
+    ref.check_reference_invariants(reference_run, srvcnt=4, cltcnt=4, idcnt=10)
+    res, in_order = ref.run_equivalent_sim(srvcnt=4, cltcnt=4, idcnt=10, seed=0)
+    assert res.done
+    seqs = validate.check_all(res.learned, res.expected_vids)
+    validate.check_in_order_clients(seqs[0], in_order)
+    # Same executed-value multiset on both sides: exactly ids 0..39.
+    ref_exec = np.sort(
+        np.asarray(
+            [int(e.value) for e in reference_run.logs[0] if not e.noop]
+        )
+    )
+    tpu_exec = np.sort(seqs[0])
+    np.testing.assert_array_equal(ref_exec, np.arange(40))
+    np.testing.assert_array_equal(tpu_exec, np.arange(40))
+
+
+def test_equivalent_workload_shape():
+    workload, gates, in_order = ref.equivalent_workload(4, 4, 10)
+    # Every id exactly once across proposers.
+    allv = np.sort(np.concatenate(workload))
+    np.testing.assert_array_equal(allv, np.arange(40))
+    # Gate chains: in-order clients 0,1; ids k=1..5 gated on k-1.
+    joined = {
+        int(v): int(g)
+        for w, gs in zip(workload, gates)
+        for v, g in zip(w, gs)
+    }
+    for c in range(2):
+        for k in range(1, 6):
+            assert joined[c * 10 + k] == c * 10 + k - 1
+        assert joined[c * 10] == -1
+        for k in range(6, 10):
+            assert joined[c * 10 + k] == -1
+    # Free clients fully ungated.
+    for c in range(2, 4):
+        for k in range(10):
+            assert joined[c * 10 + k] == -1
+    assert [len(x) for x in in_order] == [6, 6]

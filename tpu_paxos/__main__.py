@@ -1,0 +1,296 @@
+"""``python -m tpu_paxos`` — the reference CLI, TPU-framework edition.
+
+Mirrors the reference's argument surface (ref multi/main.cpp:456-521:
+positional ``srvcnt cltcnt idcnt [propose_interval]`` + ``--key=value``
+flags; canonical line in multi/debug.conf.sample:1) with the TPU-build
+extensions: ``--backend``, ``--mesh``, ``--engine``.  Wall-clock
+milliseconds become integer rounds of the bulk-synchronous schedule
+(config.py), so the debug.conf line transliterates with delay values
+scaled to rounds; ``propose_interval`` is accepted for fidelity and
+ignored (client pacing is subsumed by the round schedule and gates).
+
+Output: the decision log in the reference grammar
+(ref multi/paxos.cpp:18-22) on stdout, then an invariant verdict line
+— the same checks as the reference epilogue (ref multi/main.cpp:566-573).
+Exit code 0 iff every invariant holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_paxos",
+        description="TPU-native multi-Paxos simulation harness",
+    )
+    p.add_argument("srvcnt", type=int, help="number of server nodes")
+    p.add_argument("cltcnt", type=int, help="number of clients")
+    p.add_argument("idcnt", type=int, help="ids proposed per client")
+    p.add_argument(
+        "propose_interval",
+        type=int,
+        nargs="?",
+        default=0,
+        help="accepted for reference-CLI fidelity; pacing is subsumed "
+        "by the round schedule",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    # paxos::Config knobs, in rounds (ref multi/paxos.h:251-274).
+    p.add_argument("--paxos-prepare-delay-min", type=int, default=0)
+    p.add_argument("--paxos-prepare-delay-max", type=int, default=4)
+    p.add_argument("--paxos-prepare-retry-count", type=int, default=3)
+    p.add_argument("--paxos-prepare-retry-timeout", type=int, default=2)
+    p.add_argument("--paxos-accept-retry-count", type=int, default=3)
+    p.add_argument("--paxos-accept-retry-timeout", type=int, default=2)
+    p.add_argument("--paxos-commit-retry-timeout", type=int, default=2)
+    # THNetWork knobs (ref multi/main.cpp:51-162); delays in rounds.
+    p.add_argument("--net-drop-rate", type=int, default=0)
+    p.add_argument("--net-dup-rate", type=int, default=0)
+    p.add_argument("--net-min-delay", type=int, default=0)
+    p.add_argument("--net-max-delay", type=int, default=0)
+    p.add_argument("--crash-rate", type=int, default=0,
+                   help="per-node fail-stop crash rate per 1e6 per round "
+                   "(ref member/indet.h:146-150)")
+    p.add_argument("--log-level", type=str, default="INFO")
+    p.add_argument("--max-rounds", type=int, default=10_000)
+    # TPU-build extensions.
+    p.add_argument("--backend", choices=("tpu", "cpu", "auto"), default="auto")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="shard the instance axis over this many devices "
+                   "(0 = unsharded)")
+    p.add_argument("--engine", choices=("sim", "fast", "member"),
+                   default="sim")
+    p.add_argument("--json", action="store_true",
+                   help="emit a JSON summary instead of the verdict line")
+    return p
+
+
+def _select_backend(backend: str) -> None:
+    if backend == "auto":
+        return
+    os.environ["JAX_PLATFORMS"] = backend
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", backend)
+    except RuntimeError:
+        pass  # backend already initialized; env var did its best
+
+
+def run_sim(args) -> int:
+    import numpy as np
+
+    from tpu_paxos import config as cfgm
+    from tpu_paxos.core import sim
+    from tpu_paxos.harness import reference_runner as refr
+    from tpu_paxos.harness import validate
+    from tpu_paxos.replay.decision_log import decision_log as render_log
+    from tpu_paxos.utils import log as logm
+
+    logger = logm.get_logger("cli", _level(args))
+    workload, gates, in_order = refr.equivalent_workload(
+        args.srvcnt, args.cltcnt, args.idcnt
+    )
+    cfg = cfgm.SimConfig(
+        n_nodes=args.srvcnt,
+        n_instances=args.cltcnt * args.idcnt * 2,
+        proposers=tuple(range(args.srvcnt)),
+        seed=args.seed,
+        max_rounds=args.max_rounds,
+        protocol=cfgm.ProtocolConfig(
+            prepare_delay_min=args.paxos_prepare_delay_min,
+            prepare_delay_max=args.paxos_prepare_delay_max,
+            prepare_retry_count=args.paxos_prepare_retry_count,
+            prepare_retry_timeout=args.paxos_prepare_retry_timeout,
+            accept_retry_count=args.paxos_accept_retry_count,
+            accept_retry_timeout=args.paxos_accept_retry_timeout,
+            commit_retry_timeout=args.paxos_commit_retry_timeout,
+        ),
+        faults=cfgm.FaultConfig(
+            drop_rate=args.net_drop_rate,
+            dup_rate=args.net_dup_rate,
+            min_delay=args.net_min_delay,
+            max_delay=args.net_max_delay,
+            crash_rate=args.crash_rate,
+        ),
+    )
+    logger.info(
+        "sim: %d nodes, %d clients x %d ids, seed %d",
+        args.srvcnt, args.cltcnt, args.idcnt, args.seed,
+    )
+    res = sim.run(cfg, workload, gates)
+    sys.stdout.write(
+        render_log(
+            res.chosen_vid, res.chosen_ballot,
+            stride=args.idcnt, n_instances=cfg.n_instances,
+        )
+    )
+    ok, verdict = True, []
+    try:
+        seqs = validate.check_all(res.learned, res.expected_vids)
+        validate.check_in_order_clients(seqs[0], in_order)
+        if not res.done:
+            raise validate.InvariantViolation(
+                f"did not quiesce in {res.rounds} rounds"
+            )
+        verdict = ["agreement", "exactly_once", "in_order_clients",
+                   "quiescence"]
+    except validate.InvariantViolation as e:
+        ok = False
+        logger.error("invariant violated: %s", e)
+    summary = {
+        "engine": "sim",
+        "rounds": res.rounds,
+        "done": res.done,
+        "chosen": int((res.chosen_vid != -1).sum()),
+        "executed": int((res.chosen_vid >= 0).sum()),
+        "crashed": int(res.crashed.sum()),
+        "msgs": res.msgs.tolist(),
+        "invariants": verdict,
+        "ok": ok,
+    }
+    _emit(args, summary)
+    return 0 if ok else 1
+
+
+def run_fast(args) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_paxos.core import fast
+    from tpu_paxos.harness import validate
+    from tpu_paxos.utils import log as logm
+
+    logger = logm.get_logger("cli", _level(args))
+    n = args.cltcnt * args.idcnt
+    quorum = args.srvcnt // 2 + 1
+    vids = jnp.arange(n, dtype=jnp.int32)
+    if args.mesh:
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.parallel import sharded
+
+        mesh = pmesh.make_instance_mesh(args.mesh)
+        state = sharded.init_sharded_state(mesh, n, args.srvcnt)
+        step = sharded.sharded_choose_all(mesh, proposer=0, quorum=quorum)
+        state, n_chosen = step(state, pmesh.shard_instances(mesh, vids))
+    else:
+        state = fast.init_state(n, args.srvcnt)
+        state, n_chosen = fast.choose_all_jit(
+            state, vids, proposer=0, quorum=quorum
+        )
+    ok = True
+    try:
+        validate.check_all(np.asarray(state.learned), np.arange(n))
+    except validate.InvariantViolation as e:
+        ok = False
+        logger.error("invariant violated: %s", e)
+    _emit(args, {
+        "engine": "fast",
+        "chosen": int(n_chosen),
+        "devices": args.mesh or 1,
+        "invariants": ["agreement", "exactly_once"] if ok else [],
+        "ok": ok and int(n_chosen) == n,
+    })
+    return 0 if ok and int(n_chosen) == n else 1
+
+
+def run_member(args) -> int:
+    """member/ churn scenario: grow the cluster from 1 to srvcnt
+    acceptors, propose cltcnt*idcnt values meanwhile, shrink back, and
+    validate prefix consistency (ref member/main.cpp:101-161, 260-265)."""
+    from tpu_paxos.harness import validate
+    from tpu_paxos.membership import engine as mem
+    from tpu_paxos.utils import log as logm
+
+    logger = logm.get_logger("cli", _level(args))
+    n = args.srvcnt
+    nvals = args.cltcnt * args.idcnt
+    sim = mem.MemberSim(n, n_instances=max(4 * (nvals + 4 * n), 64),
+                        seed=args.seed)
+    vid = 0
+    for tgt in range(1, n):
+        cv = sim.add_acceptor(tgt)
+        if vid < nvals:
+            sim.propose(0, vid); vid += 1
+        if not sim.run_until(lambda: sim.applied(cv), args.max_rounds):
+            logger.error("add_acceptor(%d) never applied", tgt)
+            _emit(args, {"engine": "member", "ok": False})
+            return 1
+    # Propose via node 0 — the one node whose proposer role survives
+    # the whole churn schedule (the reference's driver also proposes
+    # through a fixed node, ref member/main.cpp:204-212).
+    while vid < nvals:
+        sim.propose(0, vid)
+        vid += 1
+        sim.run_rounds(2)
+    for tgt in range(n - 1, 0, -1):
+        cv = sim.del_acceptor(tgt)
+        if not sim.run_until(lambda: sim.applied(cv), args.max_rounds):
+            logger.error("del_acceptor(%d) never applied", tgt)
+            _emit(args, {"engine": "member", "ok": False})
+            return 1
+    # Drain: every proposed value applied at node 0 before the verdict.
+    drained = sim.run_until(
+        lambda: set(range(nvals)) <= set(sim.applied_log(0).tolist())
+        and sim.acceptor_set() == {0},
+        args.max_rounds,
+    )
+    logs = [sim.applied_log(a) for a in range(n)]
+    ok = True
+    if not drained:
+        ok = False
+        logger.error(
+            "drain incomplete: %d/%d values applied at node 0, "
+            "acceptors=%s", len(set(logs[0].tolist()) & set(range(nvals))),
+            nvals, sorted(sim.acceptor_set()),
+        )
+    try:
+        validate.check_prefix_consistency(logs)
+    except validate.InvariantViolation as e:
+        ok = False
+        logger.error("invariant violated: %s", e)
+    _emit(args, {
+        "engine": "member",
+        "rounds": int(sim.state.t),
+        "applied_node0": len(logs[0]),
+        "final_acceptors": sorted(sim.acceptor_set()),
+        "invariants": ["prefix_consistency"] if ok else [],
+        "ok": ok,
+    })
+    return 0 if ok else 1
+
+
+def _level(args) -> int:
+    from tpu_paxos.utils import log as logm
+
+    return logm.parse_level(args.log_level)
+
+
+def _emit(args, summary: dict) -> None:
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        status = "ALL INVARIANTS GREEN" if summary.get("ok") else "FAILED"
+        detail = ", ".join(
+            f"{k}={v}" for k, v in summary.items() if k not in ("ok",)
+        )
+        print(f"[{summary.get('engine')}] {status} ({detail})")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _select_backend(args.backend)
+    if args.engine == "sim":
+        return run_sim(args)
+    if args.engine == "fast":
+        return run_fast(args)
+    return run_member(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,15 @@ _NEG = jnp.int32(jnp.iinfo(jnp.int32).min)  # -inf sentinel for masked max
 # workloads simply take extra rounds).
 ASSIGN_WINDOW = 64
 
+# Idle-liveness patience: a PREPARED proposer with nothing in flight
+# while the log still has holes (or unlearned chosen values) restarts
+# its prepare after this many rounds, so holes and undelivered commits
+# left by a crashed proposer get repaired by the survivors' no-op
+# hole-filling + committed-value re-adoption (the reference repairs
+# these through the same path whenever any proposer re-prepares, ref
+# multi/paxos.cpp:1106-1130, 1184-1197).
+IDLE_RESTART_ROUNDS = 8
+
 
 class AcceptorState(NamedTuple):
     promised: jax.Array  # [A] int32 scalar promised ballot per acceptor
@@ -122,6 +131,7 @@ class ProposerState(NamedTuple):
     commit_vid: jax.Array  # [P, I] int32 values this proposer is committing
     commit_acked: jax.Array  # [P, I, A] bool
     commit_deadline: jax.Array  # [P] int32
+    stall: jax.Array  # [P] int32 rounds spent idle while the log has holes
 
 
 class Metrics(NamedTuple):
@@ -206,6 +216,7 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
             commit_vid=none(p, i),
             commit_acked=jnp.zeros((p, i, a), jnp.bool_),
             commit_deadline=jnp.zeros((p,), jnp.int32),
+            stall=jnp.zeros((p,), jnp.int32),
         ),
         net=netm.init_buffers(s, p, a, i),
         met=Metrics(
@@ -520,7 +531,15 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         acc_fail = adl & (acc_retries <= 1)
         acc_retries = jnp.where(resend_acc, acc_retries - 1, acc_retries)
 
-        do_restart = restart_p | acc_fail
+        # Idle-liveness restart: the stall counter (updated at the end
+        # of the previous round) has run out of patience.
+        idle_restart = (
+            (mode == PREPARED)
+            & (pr.stall >= IDLE_RESTART_ROUNDS)
+            & prop_alive
+        )
+
+        do_restart = restart_p | acc_fail | idle_restart
         rnd_delay = jax.random.randint(
             prng.stream(root, prng.STREAM_PREPARE_DELAY, t + 1),
             (p,),
@@ -686,6 +705,23 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         )
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
 
+        # Stall accounting for the idle-liveness restart: a proposer is
+        # idle when PREPARED with nothing undecided in flight, an empty
+        # queue and no own assignments outstanding; it accumulates
+        # stall only while the log is unresolved (holes below the
+        # chosen high-water mark, or chosen values some live node
+        # never learned).
+        unresolved = ~(contiguous & learned_ok)
+        inflight = (cur_batch != val.NONE) & (met.chosen_vid[None] == val.NONE)
+        idle_now = (
+            (mode == PREPARED)
+            & ~jnp.any(inflight, axis=1)
+            & (head == tail)
+            & jnp.all(own_assign == val.NONE, axis=1)
+            & palive2
+        )
+        stall = jnp.where(idle_now & unresolved & ~done, pr.stall + 1, 0)
+
         return SimState(
             t=t + 1,
             acc=acc,
@@ -715,6 +751,7 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
                 commit_vid=commit_vid,
                 commit_acked=commit_acked,
                 commit_deadline=commit_deadline,
+                stall=stall,
             ),
             net=net,
             met=met,

@@ -9,9 +9,10 @@ asserts each node's applied log is a prefix of node 0's
 (ref member/main.cpp:260-265).
 
 These are the framework's correctness gates: every engine run finishes
-by calling into this module.  ``tpu_paxos.native`` provides a C++ fast
-path for the heavy checks at multi-million-instance scale; this module
-is the reference implementation (numpy) and the arbiter of semantics.
+by calling into this module (numpy — vectorized, fast enough for
+multi-million-instance logs).  ``reference_runner.check_parity`` runs
+the same checks against the C++ reference binary's parsed logs, so one
+checker judges both systems.
 """
 
 from __future__ import annotations

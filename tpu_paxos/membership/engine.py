@@ -520,8 +520,15 @@ class MemberSim:
     def propose(self, node: int, vid: int) -> None:
         st = self.state
         pos = int(st.tail[node])
-        if pos >= self.c:
-            raise RuntimeError("pending queue overflow")
+        # Reserve n_instances slots of headroom for conflict requeues:
+        # assignments only target instances above the committed
+        # high-water mark and a conflicted instance is committed, so at
+        # most n_instances requeues can ever be scattered at the tail
+        # (same capacity proof as core/sim.prepare_queues).
+        if pos >= self.c - self.i:
+            raise RuntimeError(
+                "pending queue full (headroom reserved for requeues)"
+            )
         self.state = st._replace(
             pend=st.pend.at[node, pos].set(vid),
             tail=st.tail.at[node].add(1),
@@ -541,6 +548,10 @@ class MemberSim:
     def run_rounds(self, k: int) -> None:
         for _ in range(k):
             self.state = self._round(self.state)
+        # Capacity proof holds at runtime: the conflict-requeue scatter
+        # (mode="drop") must never have been pushed past the ring.
+        if int(np.max(np.asarray(self.state.tail))) > self.c:
+            raise RuntimeError("pending ring overflow: requeue lost")
 
     def run_until(self, pred, max_rounds: int = 2000, step: int = 4) -> bool:
         for _ in range(0, max_rounds, step):

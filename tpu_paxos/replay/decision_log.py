@@ -56,8 +56,16 @@ def decision_log(
         if membership is not None:
             m = membership(v)
             if m is not None:
-                proposer, seq, _ = val.decode_host(v, stride, n_instances)
-                lines.append(f"[{i}] = <{b}>({proposer}:{seq}){m}")
+                # Change vids encode (target node, kind), not
+                # (proposer, seq) — the real-vid stride decode would
+                # render meaningless large numbers.  The reference
+                # prints the proposing node here (ref
+                # multi/paxos.cpp:21-22); the change encoding doesn't
+                # carry it, so render the change's own identity.
+                from tpu_paxos.membership import engine as mem
+
+                node, kind = mem.decode_change(v)
+                lines.append(f"[{i}] = <{b}>({node}:{kind}){m}")
                 continue
         proposer, seq, _ = val.decode_host(v, stride, n_instances)
         body = payload(v) if payload is not None else str(seq)

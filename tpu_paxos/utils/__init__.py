@@ -1,1 +1,4 @@
-"""L0 primitives: PRNG streams, host logging."""
+"""L0 primitives: PRNG streams (utils/prng.py), leveled host logging
+(utils/log.py — the reference Logger analog, ref multi/paxos.cpp:74-103)."""
+
+from tpu_paxos.utils.log import Logger, get_logger  # noqa: F401

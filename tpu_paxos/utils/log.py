@@ -1,0 +1,142 @@
+"""Leveled host-side logger — the reference Logger, TPU-framework style.
+
+The reference serializes 7-level log lines with a ms timestamp, thread
+name, file:line and function to stdout under a spinlock
+(ref multi/paxos.cpp:74-103, levels at multi/paxos.h:90-110:
+TRACE, DEBUG, INFO, NOTICE, WARNING, ERROR, CRITICAL).  The TPU build
+keeps the same surface for the *host* side of the framework — harness
+drivers, runners, the CLI — while on-device visibility goes through
+dumped decision tensors (``trace_dump``) and the jax profiler
+(``profile_trace``), which is where TPU debugging actually happens.
+
+Line format (reference shape, ref multi/paxos.cpp:95-101):
+
+    [2026-07-29 12:00:00.123]\t[INFO]\t[name]\t[file.py:42]\t[fn]\tmsg
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+
+TRACE, DEBUG, INFO, NOTICE, WARNING, ERROR, CRITICAL = range(7)
+LEVEL_NAMES = ("TRACE", "DEBUG", "INFO", "NOTICE", "WARNING", "ERROR", "CRITICAL")
+
+_lock = threading.Lock()  # stdout serialization (ref Logger's SpinLock)
+
+
+def parse_level(raw: str, default: int = INFO) -> int:
+    """Numeric level from a name or digit; clamps digits to the valid
+    range, accepts the common WARN/ERR aliases, and falls back to
+    ``default`` on anything unrecognized."""
+    if not raw:
+        return default
+    if raw.isdigit():
+        return max(0, min(int(raw), CRITICAL))
+    name = {"WARN": "WARNING", "ERR": "ERROR", "CRIT": "CRITICAL"}.get(
+        raw.upper(), raw.upper()
+    )
+    try:
+        return LEVEL_NAMES.index(name)
+    except ValueError:
+        return default
+
+
+def level_from_env(default: int = INFO) -> int:
+    """Numeric level from TPU_PAXOS_LOG_LEVEL, mirroring the
+    reference's ``--log-level=N`` flag (ref multi/main.cpp:469)."""
+    return parse_level(os.environ.get("TPU_PAXOS_LOG_LEVEL", ""), default)
+
+
+class Logger:
+    """Leveled logger; messages below ``level`` are dropped."""
+
+    def __init__(self, name: str = "tpu_paxos", level: int | None = None,
+                 stream=None):
+        self.name = name
+        self.level = level_from_env() if level is None else level
+        self.stream = stream if stream is not None else sys.stderr
+
+    def log(self, level: int, msg: str, *args) -> None:
+        self._log(level, msg, args, depth=1)
+
+    def _log(self, level: int, msg: str, args, depth: int) -> None:
+        if level < self.level:
+            return
+        try:
+            frame = sys._getframe(depth + 1)
+        except ValueError:
+            frame = sys._getframe()
+        now = time.time()
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
+        ms = int((now % 1) * 1000)
+        where = f"{os.path.basename(frame.f_code.co_filename)}:{frame.f_lineno}"
+        fn = frame.f_code.co_name
+        text = msg % args if args else msg
+        line = (
+            f"[{stamp}.{ms:03d}]\t[{LEVEL_NAMES[level]}]\t[{self.name}]\t"
+            f"[{where}]\t[{fn}]\t{text}\n"
+        )
+        with _lock:
+            self.stream.write(line)
+
+    def trace(self, msg, *a):
+        self._log(TRACE, msg, a, depth=1)
+
+    def debug(self, msg, *a):
+        self._log(DEBUG, msg, a, depth=1)
+
+    def info(self, msg, *a):
+        self._log(INFO, msg, a, depth=1)
+
+    def notice(self, msg, *a):
+        self._log(NOTICE, msg, a, depth=1)
+
+    def warning(self, msg, *a):
+        self._log(WARNING, msg, a, depth=1)
+
+    def error(self, msg, *a):
+        self._log(ERROR, msg, a, depth=1)
+
+    def critical(self, msg, *a):
+        self._log(CRITICAL, msg, a, depth=1)
+
+
+_default = Logger()
+
+
+def get_logger(name: str | None = None, level: int | None = None) -> Logger:
+    if name is None and level is None:
+        return _default
+    return Logger(name or "tpu_paxos", level)
+
+
+def trace_dump(logger: Logger, label: str, arr, limit: int = 64) -> None:
+    """TRACE-level dump of a (small prefix of a) decision tensor — the
+    array analog of the reference's DumpHex wire dumps
+    (ref multi/paxos.cpp:32-44)."""
+    if TRACE < logger.level:
+        return
+    import numpy as np
+
+    a = np.asarray(arr)
+    flat = a.reshape(-1)
+    head = np.array2string(flat[:limit], max_line_width=120)
+    suffix = f" …(+{flat.size - limit})" if flat.size > limit else ""
+    logger.log(TRACE, "%s shape=%s %s%s", label, a.shape, head, suffix)
+
+
+@contextlib.contextmanager
+def profile_trace(out_dir: str | None):
+    """jax profiler window (for the bench harness); no-op when
+    ``out_dir`` is falsy."""
+    if not out_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(out_dir):
+        yield
