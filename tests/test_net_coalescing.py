@@ -3,21 +3,26 @@
 The coalescing model claims: when two in-flight copies land on the
 same (edge, type) slot, the higher-ballot / newer one wins, and every
 such artifact is equivalent to a legal drop of the older copy in the
-reference network (ref THNetWork delivers both, but the acceptor
-processes the older one first or second with the same outcome — the
-newer ballot governs, multi/paxos.cpp:1366).  These tests construct
-the adversarial case deliberately: a *delayed duplicate of an older
-accept* colliding with a newer accept on one edge, in both arrival
-orders."""
+reference network (ref THNetWork delivers both, but the newer ballot
+governs at the acceptor either way, multi/paxos.cpp:1366).  Under the
+delivery-time materialization model the calendars hold only per-edge
+ballots/presence bits, so the adversarial case — a *delayed duplicate
+of an older accept* colliding with a newer accept on one edge — must
+resolve to the newer ballot at the calendar layer, and the stale
+content cannot resurface at delivery because content is read from the
+sending proposer's current state (which has moved past the old
+ballot).  These tests construct the collision deliberately, in both
+write orders, and then pin the whole-engine safety claim under forced
+collisions.
+"""
 
 import jax.numpy as jnp
 import numpy as np
 
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import net as netm
-from tpu_paxos.core import values as val
 
-S, P, A, I = 6, 1, 3, 4
+S, P, A = 6, 1, 3
 
 
 def _plan(delay: int, edge_shape):
@@ -28,71 +33,89 @@ def _plan(delay: int, edge_shape):
     return jnp.asarray(alive), jnp.asarray(delays)
 
 
-def _send_accept(net, t, delay, ballot, batch):
+def _send_accept(net, t, delay, ballot):
     al, dl = _plan(delay, (P, A))
     send = jnp.ones((P,), bool)
-    net = net._replace(
+    return net._replace(
         acc_req=netm.write_ballot(
             net.acc_req, t, al, dl, jnp.full((P, A), ballot, jnp.int32),
             send[:, None],
         )
     )
-    nb, nbb = netm.write_content(
-        net.acc_bat, net.acc_bat_ballot, t, al, dl,
-        jnp.asarray(batch, jnp.int32).reshape(P, I),
-        jnp.full((P,), ballot, jnp.int32), send,
-    )
-    return net._replace(acc_bat=nb, acc_bat_ballot=nbb)
 
 
 def test_delayed_old_dup_collides_with_newer_accept_old_first():
-    """Old accept (ballot b1, batch X) sent at t=0 with delay 2; newer
-    accept (b2 > b1, batch Y) sent at t=1 with delay 1.  Both land in
-    arrival round 3.  The newer must win both the per-edge ballot and
-    the batch content."""
+    """Old accept (ballot b1) sent at t=0 with delay 2; newer accept
+    (b2 > b1) sent at t=1 with delay 1.  Both land in arrival round 3.
+    The newer ballot must win the per-edge slot."""
     b1 = int(bal.make(1, 0))
     b2 = int(bal.make(2, 0))
-    old_batch = [100, 101, val.NONE, val.NONE]
-    new_batch = [200, 201, 202, val.NONE]
-    net = netm.init_buffers(S, P, A, I)
-    net = _send_accept(net, jnp.int32(0), 2, b1, old_batch)  # arrives r3
-    net = _send_accept(net, jnp.int32(1), 1, b2, new_batch)  # arrives r3
-    slot = 3 % S
-    assert int(net.acc_req[slot, 0, 0]) == b2
-    assert int(net.acc_bat_ballot[slot, 0]) == b2
-    np.testing.assert_array_equal(np.asarray(net.acc_bat[slot, 0]), new_batch)
+    net = netm.init_buffers(S, P, A)
+    net = _send_accept(net, jnp.int32(0), 2, b1)  # arrives r3
+    net = _send_accept(net, jnp.int32(1), 1, b2)  # arrives r3
+    assert int(net.acc_req[3 % S, 0, 0]) == b2
 
 
 def test_delayed_old_dup_collides_with_newer_accept_new_first():
     """Same collision with write order reversed (the duplicate's
     calendar write happens after the newer message's): the stored
-    newer content must NOT be downgraded."""
+    newer ballot must NOT be downgraded."""
     b1 = int(bal.make(1, 0))
     b2 = int(bal.make(2, 0))
-    old_batch = [100, 101, val.NONE, val.NONE]
-    new_batch = [200, 201, 202, val.NONE]
-    net = netm.init_buffers(S, P, A, I)
-    net = _send_accept(net, jnp.int32(1), 1, b2, new_batch)  # arrives r3
-    net = _send_accept(net, jnp.int32(0), 2, b1, old_batch)  # arrives r3
-    slot = 3 % S
-    assert int(net.acc_req[slot, 0, 0]) == b2
-    assert int(net.acc_bat_ballot[slot, 0]) == b2
-    np.testing.assert_array_equal(np.asarray(net.acc_bat[slot, 0]), new_batch)
+    net = netm.init_buffers(S, P, A)
+    net = _send_accept(net, jnp.int32(1), 1, b2)  # arrives r3
+    net = _send_accept(net, jnp.int32(0), 2, b1)  # arrives r3
+    assert int(net.acc_req[3 % S, 0, 0]) == b2
 
 
-def test_equal_ballot_batches_merge_union():
-    """Two same-ballot accept batches covering disjoint instances (one
-    proposer's successive sends) merge by union — neither clobbers the
-    other's instances to NONE."""
-    b = int(bal.make(3, 0))
-    first = [300, val.NONE, val.NONE, val.NONE]
-    second = [val.NONE, 301, val.NONE, val.NONE]
-    net = netm.init_buffers(S, P, A, I)
-    net = _send_accept(net, jnp.int32(0), 2, b, first)
-    net = _send_accept(net, jnp.int32(1), 1, b, second)
-    slot = 3 % S
-    got = np.asarray(net.acc_bat[slot, 0])
-    assert got[0] == 300 and got[1] == 301
+def test_stale_ballot_delivery_is_dropped_by_engine():
+    """Delivery-time content validity: an in-flight accept whose
+    proposer has since restarted at a higher ballot materializes no
+    content (has_acc requires edge ballot == the proposer's CURRENT
+    ballot).  Constructed at the engine level: seed an acc_req arrival
+    carrying a ballot below the proposer's current one and assert the
+    acceptor stores nothing from it."""
+    import numpy as _np
+
+    from tpu_paxos.config import SimConfig
+    from tpu_paxos.core import sim
+    from tpu_paxos.utils import prng
+
+    cfg = SimConfig(
+        n_nodes=3, n_instances=8, proposers=(0,), seed=0, max_rounds=50
+    )
+    pend, gate, tail, c = sim.prepare_queues(cfg, [_np.zeros((0,), _np.int32)])
+    root = prng.root_key(0)
+    st = sim.init_state(cfg, pend, gate, tail, root)
+    old_ballot = bal.make(1, 0)
+    cur_ballot = bal.make(5, 0)
+    # Proposer 0 is PREPARED at cur_ballot with a quiet in-flight batch
+    # (deadlines pushed out so it sends nothing); a stale accept at
+    # old_ballot is already in flight, arriving at round t=1.
+    st = st._replace(
+        prop=st.prop._replace(
+            mode=st.prop.mode.at[0].set(sim.PREPARED),
+            ballot=st.prop.ballot.at[0].set(cur_ballot),
+            cur_batch=st.prop.cur_batch.at[0, 0].set(7),
+            own_assign=st.prop.own_assign.at[0, 0].set(7),
+            acc_deadline=st.prop.acc_deadline.at[0].set(100),
+            acc_retries=st.prop.acc_retries.at[0].set(3),
+        ),
+        net=st.net._replace(
+            acc_req=st.net.acc_req.at[
+                1 % st.net.acc_req.shape[0], 0, :
+            ].set(old_ballot)
+        ),
+    )
+    round_fn = sim.build_engine(cfg, c)
+    st2 = round_fn(root, st)  # t=0: nothing arrives
+    st3 = round_fn(root, st2)  # t=1: the stale accept arrives
+    # Nothing was stored from the stale delivery (the proposer's
+    # current batch is at cur_ballot, the edge ballot is old_ballot),
+    # but the stale ballot itself was observed.
+    assert bool(jnp.all(st3.acc.acc_ballot == bal.NONE))
+    assert bool(jnp.all(st3.acc.acc_vid == -1))
+    assert int(jnp.max(st3.acc.max_seen)) >= int(old_ballot)
 
 
 def test_engine_safety_under_forced_collisions():

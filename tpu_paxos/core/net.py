@@ -1,4 +1,4 @@
-"""Bulk-synchronous network: ring-calendar message buffers + fault masks.
+"""Bulk-synchronous network: edge-scalar ring calendars + fault masks.
 
 This is the TPU-native reframing of the reference's ``NetWork`` SPI and
 ``THNetWork`` fault injector (ref multi/paxos.h:193-212,
@@ -7,6 +7,24 @@ in fixed-size *arrival calendars*: for each message type there is a
 ring buffer whose leading axis is "arrives in k rounds"; a message
 sent at round ``t`` with sampled delay ``d`` is written at slot
 ``(t + 1 + d) % S`` and popped when the round counter reaches it.
+
+Every calendar stores only a per-edge scalar (a ballot, or a presence
+bit) — O(S * P * A) memory, independent of the instance count.  The
+per-instance payloads the reference serializes into each message
+(prepare-reply accepted-value snapshots, accept batches, commit
+batches, per-instance acks) are *materialized at delivery time* from
+the sender's state arrays instead of being buffered.  Each
+materialized payload equals the payload of a message the sender could
+legally have sent at the delivery round: sender state only grows
+monotonically along the protocol's safe directions (promises and
+``max_seen`` are monotone; accepted values are only replaced at >=
+ballots; ``learned``/``commit_vid`` are write-once), so reading it at
+delivery time is exactly equivalent to the reference scheduling the
+sender's reply later and delivering it instantly — a schedule
+``THNetWork``'s random delays already contain.  Payloads whose
+validity condition no longer holds at delivery (an accept whose
+proposer has since moved to a higher ballot) are treated as dropped,
+which is likewise a schedule the reference's drop fault contains.
 
 Fault semantics follow ``THNetWork::HijackSend``
 (ref multi/main.cpp:116-132) exactly:
@@ -22,10 +40,9 @@ Coalescing model: at most one message per (edge, type) is delivered
 per round; when two in-flight copies land on the same slot the
 higher-ballot / newer one wins.  Every such coalescing artifact is
 equivalent to a legal drop-and-delay schedule of the reference
-network, because all proposer→acceptor messages are broadcasts of
-idempotent content and replies only collide with older replies on the
-same edge — so the engine's reachable interleavings are a subset of
-the reference network's.
+network, because the per-edge scalar is a ballot (monotone — the
+higher one governs at the receiver, ref multi/paxos.cpp:1366) or a
+presence bit (idempotent).
 """
 
 from __future__ import annotations
@@ -37,7 +54,6 @@ import jax.numpy as jnp
 
 from tpu_paxos.config import FaultConfig
 from tpu_paxos.core import ballot as bal
-from tpu_paxos.core import values as val
 
 MAX_COPIES = 4  # original + up to 3 recursive duplicates, ref multi/main.cpp:120
 
@@ -45,52 +61,46 @@ MAX_COPIES = 4  # original + up to 3 recursive duplicates, ref multi/main.cpp:12
 class NetBuffers(NamedTuple):
     """Arrival calendars, leading axis S = max_delay + 2 ring slots.
 
-    P = number of proposers, A = number of nodes (acceptors/learners),
-    I = instance capacity.  ``NONE`` (-1) marks "no message".
+    P = number of proposers, A = number of nodes (acceptors/learners).
+    ``NONE`` (-1) marks "no message".  All per-instance payloads are
+    delivery-time materialized (see module docstring).
     """
 
     # PREPARE (ref MSG_PREPARE): proposer -> acceptor, ballot only (the
     # interval-set payload is implicit: all instances).
     prep_req: jax.Array  # [S, P, A] int32 ballot
     # PREPARE_REPLY (granted only, ref MSG_PREPARE_REPLY): acceptor ->
-    # proposer, echo ballot + snapshot of the acceptor's accepted state.
+    # proposer, echo ballot; the accepted-state snapshot is read from
+    # the acceptor's arrays at delivery.
     prep_echo: jax.Array  # [S, A, P] int32 ballot echo
-    prep_ab: jax.Array  # [S, A, P, I] int32 accepted-ballot snapshot
-    prep_av: jax.Array  # [S, A, P, I] int32 accepted-vid snapshot
     # REJECT (ref MSG_REJECT, shared by both phases): max ballot seen.
     rej: jax.Array  # [S, A, P] int32 max ballot (NONE = no reject)
-    # ACCEPT (ref MSG_ACCEPT): per-edge ballot + per-proposer value
-    # batch (content is identical across edges — a broadcast).
+    # ACCEPT (ref MSG_ACCEPT): per-edge ballot; the batch content is
+    # the sending proposer's cur_batch at delivery, valid iff its
+    # ballot still equals the edge ballot.
     acc_req: jax.Array  # [S, P, A] int32 ballot (NONE = no message)
-    acc_bat: jax.Array  # [S, P, I] int32 vid batch content
-    acc_bat_ballot: jax.Array  # [S, P] int32 ballot of stored content
-    # ACCEPT_REPLY (ref MSG_ACCEPT_REPLY): echo + per-instance acks.
+    # ACCEPT_REPLY (ref MSG_ACCEPT_REPLY): echo; per-instance acks are
+    # derived from the acceptor's accepted/learned state at delivery.
     acc_echo: jax.Array  # [S, A, P] int32 ballot echo
-    acc_ack: jax.Array  # [S, A, P, I] bool instance acked
-    # COMMIT (ref MSG_COMMIT): chosen-value batch to every node.
+    # COMMIT (ref MSG_COMMIT): presence; content is the sender's
+    # (write-once) commit_vid array at delivery.
     com_pres: jax.Array  # [S, P, A] bool edge presence
-    com_bat: jax.Array  # [S, P, I] int32 chosen vids (NONE = not in batch)
-    # COMMIT_REPLY (ref MSG_COMMIT_REPLY): per-instance acks.
-    com_ack: jax.Array  # [S, A, P, I] bool
+    # COMMIT_REPLY (ref MSG_COMMIT_REPLY): presence; per-instance acks
+    # derive from learned-state match at delivery.
+    com_rep: jax.Array  # [S, A, P] bool
 
 
-def init_buffers(s: int, p: int, a: int, i: int) -> NetBuffers:
+def init_buffers(s: int, p: int, a: int) -> NetBuffers:
     none = lambda *shape: jnp.full(shape, bal.NONE, jnp.int32)  # noqa: E731
     false = lambda *shape: jnp.zeros(shape, jnp.bool_)  # noqa: E731
     return NetBuffers(
         prep_req=none(s, p, a),
         prep_echo=none(s, a, p),
-        prep_ab=none(s, a, p, i),
-        prep_av=none(s, a, p, i),
         rej=none(s, a, p),
         acc_req=none(s, p, a),
-        acc_bat=none(s, p, i),
-        acc_bat_ballot=none(s, p),
         acc_echo=none(s, a, p),
-        acc_ack=false(s, a, p, i),
         com_pres=false(s, p, a),
-        com_bat=none(s, p, i),
-        com_ack=false(s, a, p, i),
+        com_rep=false(s, a, p),
     )
 
 
@@ -164,41 +174,7 @@ def write_ballot(buf, t, alive, delay, value, send_mask):
     return jnp.maximum(buf, jnp.where(mask, value[None], bal.NONE))
 
 
-def write_bool(buf, t, alive, delay, value, send_mask):
-    """Coalesce-or write of boolean per-instance payloads ([.., I])."""
+def write_flag(buf, t, alive, delay, send_mask):
+    """Coalesce-or write of a presence-bit message into its calendar."""
     s = buf.shape[0]
-    mask = _slot_onehot(t, s, alive, delay) & send_mask[None]
-    return buf | (mask[..., None] & value[None])
-
-
-def write_row(buf, t, alive, delay, value, send_mask, newer):
-    """Write per-edge [I]-rows; overwrite an existing row iff ``newer``
-    ([S, *edge] bool, computed by the caller from echo ballots across
-    all slots)."""
-    s = buf.shape[0]
-    mask = _slot_onehot(t, s, alive, delay) & send_mask[None] & newer
-    return jnp.where(mask[..., None], value[None], buf)
-
-
-def write_content(bat, bat_ballot, t, alive, delay, content, ballot, send):
-    """Per-proposer broadcast content ([P, I] vids at [P] ballot):
-    higher-ballot content replaces, equal-ballot content merges
-    (union of non-NONE entries — in-flight accept batches at one
-    ballot cover disjoint or identical instances)."""
-    s = bat.shape[0]
-    # content is per-proposer; it must be present at every slot where
-    # ANY surviving copy of ANY edge's message arrives (the content
-    # calendar is per [S, P] while delivery is per-edge).
-    slots = (t + 1 + delay) % s  # [C, P, A]
-    oh = jnp.arange(s).reshape((s, 1, 1, 1))
-    arrive = jnp.any((slots[None] == oh) & alive[None], axis=(1, 3))  # [S, P]
-    mask = arrive & send[None]
-    newer = mask & (ballot[None] > bat_ballot)
-    equal = mask & (ballot[None] == bat_ballot)
-    new_bat = jnp.where(newer[..., None], content[None], bat)
-    new_bat = jnp.where(
-        equal[..., None] & (content[None] != val.NONE), content[None], new_bat
-    )
-    new_ballot = jnp.where(newer, ballot[None], bat_ballot)
-    return new_bat, new_ballot
-
+    return buf | (_slot_onehot(t, s, alive, delay) & send_mask[None])

@@ -51,6 +51,15 @@ Protocol semantics (each with its reference anchor):
   re-queued and assigned a fresh instance
   (ref multi/paxos.cpp:1540-1569 OnCommit).
 
+Network model: calendars hold only per-edge scalars (ballots /
+presence bits); every per-instance payload — prepare-reply snapshots,
+accept batches, commit batches, per-instance acks — is materialized
+at delivery time from the sender's current state arrays, which is
+equivalent to the reference scheduling the sender's send later (see
+core/net.py's module docstring for the legality argument).  This
+makes network memory O(S*P*A), independent of the instance count, so
+the general engine scales to millions of instances.
+
 Fault injection (drop/dup/delay per THNetWork, crash per member/'s
 RandomFailure) rides the network layer — see core/net.py.  Crashes
 are fail-stop node silences capped at a minority of nodes (the
@@ -218,7 +227,7 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
             commit_deadline=jnp.zeros((p,), jnp.int32),
             stall=jnp.zeros((p,), jnp.int32),
         ),
-        net=netm.init_buffers(s, p, a, i),
+        net=netm.init_buffers(s, p, a),
         met=Metrics(
             chosen_vid=none(i),
             chosen_round=none(i),
@@ -306,15 +315,21 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
             acc.promised, jnp.max(jnp.where(grant, preq, bal.NONE), axis=0)
         )
 
-        # ACCEPT arrivals.
+        # ACCEPT arrivals.  Batch content is materialized at delivery
+        # from the sending proposer's cur_batch (pre-round state), valid
+        # iff its ballot still equals the arriving edge ballot and it is
+        # still PREPARED; stale in-flight accepts (the proposer has
+        # since restarted at a higher ballot and cleared the batch) are
+        # dropped — a schedule the drop fault already contains.
         apres = jnp.where(alive_a[None, :], ar.acc_req, bal.NONE)  # [P, A]
-        abal = ar.acc_bat_ballot  # [P] content ballot
-        abat = ar.acc_bat  # [P, I]
-        has_acc = apres != bal.NONE
-        max_seen = jnp.maximum(
-            max_seen,
-            jnp.max(jnp.where(has_acc, abal[:, None], bal.NONE), axis=0),
-        )
+        abal = st.prop.ballot  # [P] content ballot (current)
+        abat = st.prop.cur_batch  # [P, I]
+        has_acc = (apres != bal.NONE) & (apres == abal[:, None]) & (
+            st.prop.mode == PREPARED
+        )[:, None]
+        # the edge ballot itself did travel: it bumps max_seen even
+        # when the content is stale-dropped (ref acceptor sees it).
+        max_seen = jnp.maximum(max_seen, jnp.max(apres, axis=0))
         elig = has_acc & (abal[:, None] >= promised)  # >=, ref :1366
         rej_acc = has_acc & ~elig
         w_has = abat != val.NONE  # [P, I]
@@ -337,9 +352,11 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         acc_vid = jnp.where(do_store, store_v, acc.acc_vid)
 
         # COMMIT arrivals -> learner state (ref OnCommit,
-        # multi/paxos.cpp:1494-1518).
+        # multi/paxos.cpp:1494-1518).  Content is the sender's
+        # write-once commit_vid array at delivery (a superset of the
+        # send-time batch — a legal later send).
         cpres = ar.com_pres & alive_a[None, :]  # [P, A]
-        cbat = ar.com_bat  # [P, I]
+        cbat = st.prop.commit_vid  # [P, I]
         inc = cpres[:, None, :] & (cbat != val.NONE)[:, :, None]  # [P, I, A]
         has_inc = jnp.any(inc, axis=0)  # [I, A]
         inc_v = jnp.max(jnp.where(inc, cbat[:, :, None], _NEG), axis=0)
@@ -353,16 +370,26 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         rejs = jnp.where(alive_a[:, None], ar.rej, bal.NONE)  # [A, P]
         pmax_seen = jnp.maximum(pr.pmax_seen, jnp.max(rejs, axis=0))
 
-        # PREPARE_REPLY arrivals: promises + adoption merge.
+        # PREPARE_REPLY arrivals: promises + adoption merge.  The
+        # accepted-state snapshot is the acceptor's state at delivery
+        # (the pre-round snap_b/snap_v above) — equivalent to the
+        # acceptor processing the prepare at the delivery round, which
+        # is strictly safer: its promise took effect earlier, and a
+        # fresher snapshot's max-ballot value is exactly what a
+        # later-generated reply would report.
         pecho = jnp.where(alive_a[:, None], ar.prep_echo, bal.NONE)  # [A, P]
         match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
         promises2 = pr.promises | match.T  # [P, A]
-        pab = jnp.moveaxis(ar.prep_ab, 0, -1)  # [P, I, A]
-        pav = jnp.moveaxis(ar.prep_av, 0, -1)
-        repb = jnp.where(match.T[:, None, :], pab, bal.NONE)  # [P, I, A]
+        repb = jnp.where(
+            match.T[:, None, :], jnp.broadcast_to(snap_b[None], (p, i_cap, a)),
+            bal.NONE,
+        )  # [P, I, A]
         best_a = jnp.argmax(repb, axis=-1)  # [P, I]
         best_b = jnp.max(repb, axis=-1)  # [P, I]
-        best_v = jnp.take_along_axis(pav, best_a[..., None], axis=-1)[..., 0]
+        best_v = jnp.take_along_axis(
+            jnp.broadcast_to(snap_v[None], (p, i_cap, a)), best_a[..., None],
+            axis=-1,
+        )[..., 0]
         take = (best_b != bal.NONE) & (best_b > pr.adopted_b)
         adopted_b = jnp.where(take, best_b, pr.adopted_b)
         adopted_v = jnp.where(take, best_v, pr.adopted_v)
@@ -450,10 +477,24 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         )
         added = k > 0
 
-        # ACCEPT_REPLY arrivals: per-instance acks for current ballot.
+        # ACCEPT_REPLY arrivals: per-instance acks for current ballot,
+        # derived at delivery: the acceptor currently holds this
+        # batch's value at this ballot (so it certifiably stored
+        # (ballot, v)), or committed exactly this value.  Acks lost to
+        # higher-ballot overwrites in between are reply drops — legal.
         aecho = jnp.where(alive_a[:, None], ar.acc_echo, bal.NONE)  # [A, P]
         amatch = (aecho == pr.ballot[None, :]) & (mode[None, :] == PREPARED)
-        acks = acks | (jnp.moveaxis(ar.acc_ack, 0, -1) & amatch.T[:, None, :])
+        hold = (acc.acc_vid[None] == cur_batch[:, :, None]) & (
+            acc.acc_ballot[None] == pr.ballot[:, None, None]
+        )  # [P, I, A]
+        comm = (learned[None] == cur_batch[:, :, None]) & (
+            learned[None] != val.NONE
+        )
+        acks = acks | (
+            amatch.T[:, None, :]
+            & (cur_batch != val.NONE)[:, :, None]
+            & (hold | comm)
+        )
         n_ack = jnp.sum(acks, axis=-1)  # [P, I]
         inst_chosen = (cur_batch != val.NONE) & (n_ack >= quorum)
         newly = inst_chosen & (pr.commit_vid == val.NONE) & prop_alive[:, None]
@@ -472,14 +513,22 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         # COMMIT sends: newly chosen + deadline resends of batches not
         # yet acked by every live node (ref :1625-1641 retries until
         # ALL nodes replied; crashed nodes are excused).
-        commit_acked = pr.commit_acked | jnp.moveaxis(ar.com_ack, 0, -1)
+        # COMMIT_REPLY delivery: a presence bit; the per-instance ack
+        # derives from learned-state match (learned is write-once, so
+        # this is exact — the replier has learned the value iff its
+        # learned cell equals the committed vid).
+        crep = ar.com_rep & alive_a[:, None]  # [A, P]
+        commit_acked = pr.commit_acked | (
+            crep.T[:, None, :]
+            & (commit_vid != val.NONE)[:, :, None]
+            & (learned[None] == commit_vid[:, :, None])
+        )
         not_all_acked = (commit_vid != val.NONE) & ~jnp.all(
             commit_acked | st.crashed[None, None, :], axis=-1
         )
         resend_c = (t >= pr.commit_deadline)[:, None] & not_all_acked
         send_commit_i = (newly | resend_c) & prop_alive[:, None]  # [P, I]
         send_commit = jnp.any(send_commit_i, axis=1)
-        com_content = jnp.where(send_commit_i, commit_vid, val.NONE)
         commit_deadline = jnp.where(
             send_commit, t + 1 + pc.commit_retry_timeout, pr.commit_deadline
         )
@@ -586,25 +635,14 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
                 net.prep_req, t, al, dl, ballot[:, None], send_prep[:, None]
             )
         )
-        # prepare replies (granted only) + snapshots
+        # prepare replies (granted only; snapshot read at delivery)
         al, dl = netm.copy_plan(keys[1], (a, p), fc)
         send_rep = grant.T  # [A, P]
         echo_val = preq.T  # [A, P] the granted ballot
-        newer = echo_val[None] >= net.prep_echo  # [S, A, P]
         net = net._replace(
             prep_echo=netm.write_ballot(
                 net.prep_echo, t, al, dl, echo_val, send_rep
-            ),
-            prep_ab=netm.write_row(
-                net.prep_ab, t, al, dl,
-                jnp.broadcast_to(snap_b.T[:, None, :], (a, p, i_cap)),
-                send_rep, newer,
-            ),
-            prep_av=netm.write_row(
-                net.prep_av, t, al, dl,
-                jnp.broadcast_to(snap_v.T[:, None, :], (a, p, i_cap)),
-                send_rep, newer,
-            ),
+            )
         )
         # rejects (both phases share one message, ref MSG_REJECT)
         al, dl = netm.copy_plan(keys[2], (a, p), fc)
@@ -615,54 +653,35 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
                 jnp.broadcast_to(max_seen[:, None], (a, p)), send_rej,
             )
         )
-        # accepts: per-edge ballot + per-proposer batch content
+        # accepts: per-edge ballot (batch content read at delivery)
         al, dl = netm.copy_plan(keys[3], edge_pa, fc)
         net = net._replace(
             acc_req=netm.write_ballot(
                 net.acc_req, t, al, dl, ballot[:, None], send_accept[:, None]
             )
         )
-        nb_, nbb_ = netm.write_content(
-            net.acc_bat, net.acc_bat_ballot, t, al, dl,
-            cur_batch, ballot, send_accept,
-        )
-        net = net._replace(acc_bat=nb_, acc_bat_ballot=nbb_)
-        # accept replies
+        # accept replies (ack rows derived at delivery)
         al, dl = netm.copy_plan(keys[4], (a, p), fc)
         send_arep = elig.T  # [A, P] reply whenever ballot >= promised
         aecho_val = jnp.broadcast_to(abal[None, :], (a, p))
-        newer_a = aecho_val[None] >= net.acc_echo
         net = net._replace(
             acc_echo=netm.write_ballot(
                 net.acc_echo, t, al, dl, aecho_val, send_arep
-            ),
-            acc_ack=netm.write_row(
-                net.acc_ack, t, al, dl,
-                jnp.moveaxis(ack, 2, 0), send_arep, newer_a,
-            ),
+            )
         )
-        # commits: per-edge presence + per-proposer content (merged by
-        # union — commits never disagree, that's the agreement invariant)
+        # commits: per-edge presence (content read at delivery from
+        # the sender's write-once commit_vid)
         al, dl = netm.copy_plan(keys[5], edge_pa, fc)
-        arrive_pa = netm._slot_onehot(t, s, al, dl)  # [S, P, A]
         net = net._replace(
-            com_pres=net.com_pres
-            | (arrive_pa & send_commit[None, :, None]),
-            com_bat=jnp.where(
-                (jnp.any(arrive_pa, axis=-1) & send_commit[None, :])[..., None]
-                & (com_content[None] != val.NONE),
-                com_content[None],
-                net.com_bat,
-            ),
+            com_pres=netm.write_flag(
+                net.com_pres, t, al, dl, send_commit[:, None]
+            )
         )
-        # commit replies: ack every instance present in the commit
+        # commit replies: presence; ack-by-learned-match at delivery
         al, dl = netm.copy_plan(keys[6], (a, p), fc)
-        crep_rows = jnp.moveaxis(inc, 2, 0)  # [A, P, I]
         send_crep = cpres.T  # [A, P]
         net = net._replace(
-            com_ack=netm.write_bool(
-                net.com_ack, t, al, dl, crep_rows, send_crep
-            )
+            com_rep=netm.write_flag(net.com_rep, t, al, dl, send_crep)
         )
 
         # message counters (logical sends, pre-fault)
@@ -716,6 +735,7 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         idle_now = (
             (mode == PREPARED)
             & ~jnp.any(inflight, axis=1)
+            & ~jnp.any(not_all_acked, axis=1)  # commit repair in flight
             & (head == tail)
             & jnp.all(own_assign == val.NONE, axis=1)
             & palive2

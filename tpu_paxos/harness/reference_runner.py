@@ -77,6 +77,7 @@ def build_reference(build_dir: str = DEFAULT_BUILD_DIR) -> str:
     srcs = [
         os.path.join(REFERENCE_DIR, "main.cpp"),
         os.path.join(REFERENCE_DIR, "paxos.cpp"),
+        os.path.join(REFERENCE_DIR, "paxos.h"),
     ]
     if os.path.exists(binary) and all(
         os.path.getmtime(binary) >= os.path.getmtime(s) for s in srcs
