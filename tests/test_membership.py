@@ -7,9 +7,11 @@ is in flight (ref member/main.cpp:204-212), acceptors are then
 deleted, and every node's applied log must be a prefix of node 0's
 (ref member/main.cpp:260-265)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tpu_paxos.core import values as val
 from tpu_paxos.harness import validate
 from tpu_paxos.membership import (
     ADD_ACCEPTOR,
@@ -130,16 +132,192 @@ def test_churn_grow_then_shrink_baseline_config5():
     assert (counts == 1).all()
 
 
-def test_version_gates_stale_accepts():
-    """A proposer with a stale view must not get values accepted until
-    it catches up (ref member/paxos.cpp:1702, 1747): after a change
-    applies, the old version's quorum is dead."""
+def test_same_version_members_still_choose():
+    """Sanity companion to the stale-version test: two members at the
+    same version are NOT gated — a proposal through the newer member
+    lands."""
     ms = MemberSim(n_nodes=3, n_instances=32, seed=0)
     c = ms.add_acceptor(1)
     assert ms.run_until(lambda: ms.applied(c), max_rounds=400)
     v0 = int(np.asarray(ms.state.version)[0])
-    # both members now at the same version; a proposal still lands
     ms.propose(1, 200)
     assert ms.run_until(lambda: ms.chosen(200), max_rounds=800)
     assert int(np.asarray(ms.state.version)[1]) == v0
     _check_prefix(ms, 2)
+
+
+def test_stale_version_proposer_blocked_until_catchup():
+    """The real version gate (ref member/paxos.cpp:1702, 1747): a
+    proposer whose view lags behind an acceptor change must get NOTHING
+    accepted — no promise, no accept, no choice — until its learn
+    frontier catches up, after which its proposal lands normally.
+
+    Construction: after two acceptor changes and a few plain values,
+    node 1 is rewound to its bootstrap state (seed view {0}, version 0,
+    empty learner log).  Its catch-up is paced by the one-instance-per-
+    round anti-entropy pull, which opens a multi-round stale window to
+    observe the gate acting."""
+    ms = MemberSim(n_nodes=3, n_instances=32, seed=1)
+    a = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(a), max_rounds=400)
+    # plain values BETWEEN the changes, so node 1's rewound frontier
+    # must pull through them (one per round) before reaching change b
+    fill = [100, 101, 102, 103]
+    for v in fill:
+        ms.propose(0, v)
+    _drain(ms, fill)
+    b = ms.add_acceptor(2)
+    assert ms.run_until(lambda: ms.applied(b), max_rounds=400)
+    v_cur = int(np.asarray(ms.state.version)[0])
+    assert v_cur == 2
+
+    st = ms.state
+    n = ms.n
+    seed_row = jnp.zeros((n,), jnp.bool_).at[0].set(True)
+    ms.state = st._replace(
+        learners=st.learners.at[1].set(seed_row),
+        proposers=st.proposers.at[1].set(seed_row),
+        acceptors=st.acceptors.at[1].set(seed_row),
+        version=st.version.at[1].set(0),
+        applied_upto=st.applied_upto.at[1].set(0),
+        learned=st.learned.at[:, 1].set(val.NONE),
+        prepared=st.prepared.at[1].set(False),
+    )
+    ms.propose(1, 300)
+
+    # While node 1's version lags, the gate must hold: 300 is never
+    # promised into existence — no acceptor stores it, nobody chooses
+    # it, and node 1 never reaches prepared (its rewound view's quorum
+    # is acceptor 0, which is at version 2 and drops its prepares).
+    stale_rounds = 0
+    while int(np.asarray(ms.state.version)[1]) < v_cur:
+        assert not np.any(np.asarray(ms.state.acc_vid) == 300)
+        assert not ms.chosen(300)
+        assert not bool(np.asarray(ms.state.prepared)[1])
+        ms.run_rounds(1)
+        stale_rounds += 1
+        assert stale_rounds < 200, "node 1 never caught up"
+    # the gate had a real multi-round window to act in
+    assert stale_rounds >= 3
+
+    # Caught up: the proposal now lands and logs stay prefix-consistent.
+    assert ms.run_until(lambda: ms.chosen(300), max_rounds=800)
+    _check_prefix(ms, 3)
+
+
+def test_orphaned_accepted_value_repaired_by_idle_proposer():
+    """A value accepted by a live acceptor whose proposer died before
+    choosing it must still be chosen: an idle live proposer's
+    idle-liveness re-prepare adopts and re-accepts it.  Without the
+    repair the apply frontier of every node wedges at the orphan."""
+    ms = MemberSim(n_nodes=3, n_instances=16, seed=0)
+    a = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(a), max_rounds=400)
+    b = ms.add_acceptor(2)
+    assert ms.run_until(lambda: ms.applied(b), max_rounds=400)
+    st = ms.state
+    # craft the orphan at the next free instance: acceptor 1 holds 777
+    # accepted at a low real ballot, nobody chose it, no pending work
+    # exists anywhere
+    k = int(np.max(np.flatnonzero(np.asarray(st.chosen_vid) != val.NONE))) + 1
+    orphan_ballot = (1 << 16) | 1
+    ms.state = st._replace(
+        acc_ballot=st.acc_ballot.at[k, 1].set(orphan_ballot),
+        acc_vid=st.acc_vid.at[k, 1].set(777),
+    )
+    assert ms.run_until(lambda: ms.chosen(777), max_rounds=400), (
+        "orphaned accepted value never repaired"
+    )
+    # and it flows through to every live node's applied log
+    assert ms.run_until(
+        lambda: all(777 in ms.applied_log(i).tolist() for i in range(3)),
+        max_rounds=400,
+    )
+    _check_prefix(ms, 3)
+
+
+def test_del_live_acceptor_guard():
+    """Deleting a live acceptor while crashed ones remain would leave
+    the view without a live majority — the host-side guard refuses."""
+    ms = MemberSim(n_nodes=5, n_instances=48, seed=0)
+    for tgt in (1, 2, 3, 4):
+        c = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(c), max_rounds=2000), tgt
+    st = ms.state
+    ms.state = st._replace(
+        crashed=st.crashed.at[1].set(True).at[2].set(True)
+    )
+    # view {0..4}: quorum 3, live {0,3,4} — deleting live 3 would leave
+    # 2 live of a 3-quorum view
+    with pytest.raises(ValueError, match="delete crashed members first"):
+        ms.del_acceptor(3)
+    # the mirror hazard: adding a crashed node inflates the quorum
+    with pytest.raises(ValueError, match="has crashed"):
+        ms.add_acceptor(2)
+    # deleting a crashed member is the sanctioned repair
+    d = ms.del_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(d), max_rounds=2000)
+    assert ms.acceptor_set(0) == {0, 2, 3, 4}
+    # pipelined deletions are checked against the PROJECTED view: del 3
+    # alone is fine, but a queued del 4 on top of the un-applied del 3
+    # would leave live {0} of a 2-quorum view (a naive per-call check
+    # against the current view would admit both and wedge the cluster)
+    ms.del_acceptor(3)
+    with pytest.raises(ValueError, match="live acceptors"):
+        ms.del_acceptor(4)
+
+
+def test_churn_with_crashes_survivors_progress():
+    """The composed capability the reference cannot demonstrate live:
+    random fail-stop crashes (ref member/indet.h:146-150 RandomFailure
+    semantics, minority-capped) DURING live reconfiguration, with the
+    surviving majority completing the churn and every log — including
+    the frozen logs of crashed nodes — prefix-consistent."""
+    n = 7
+    # ~56-round run: 8000/1e6 per node-round makes crashes near-certain
+    # (this seed admits three) while the admission cap keeps a live
+    # majority in every view
+    ms = MemberSim(n_nodes=n, n_instances=96, seed=2, crash_rate=8000)
+    proposed = []
+    nv = [0]
+
+    def burst(k=2):
+        out = []
+        for _ in range(k):
+            ms.propose(0, nv[0])
+            out.append(nv[0])
+            nv[0] += 1
+        return out
+
+    # Grow, skipping targets that have already crashed (the reference's
+    # driver would have aborted the whole run at the first crash; a
+    # live operator does not add dead nodes).
+    for tgt in range(1, n):
+        if tgt in ms.crashed_set():
+            continue
+        proposed += burst()
+        c = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(c), max_rounds=3000), tgt
+
+    # Shrink back to {0}: dead members first (their removal restores
+    # live-majority headroom), then live ones.
+    for _ in range(2 * n):
+        accs = ms.acceptor_set(0) - {0}
+        if not accs:
+            break
+        dead = sorted(accs & ms.crashed_set())
+        tgt = dead[0] if dead else max(accs)
+        c = ms.del_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(c), max_rounds=3000), tgt
+    assert ms.acceptor_set(0) == {0}
+
+    proposed += burst()
+    _drain(ms, proposed)
+
+    # The run is only meaningful if crashes actually happened.
+    assert len(ms.crashed_set()) >= 1, "tune seed/crash_rate: no crash fired"
+    logs = [ms.applied_log(i) for i in range(n)]
+    validate.check_prefix_consistency(logs)
+    assert sorted(logs[0].tolist()) == sorted(proposed)
+    counts = np.unique(logs[0], return_counts=True)[1]
+    assert (counts == 1).all()

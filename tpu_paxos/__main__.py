@@ -211,9 +211,12 @@ def run_member(args) -> int:
     n = args.srvcnt
     nvals = args.cltcnt * args.idcnt
     sim = mem.MemberSim(n, n_instances=max(4 * (nvals + 4 * n), 64),
-                        seed=args.seed)
+                        seed=args.seed, crash_rate=args.crash_rate)
     vid = 0
     for tgt in range(1, n):
+        if tgt in sim.crashed_set():
+            logger.info("skipping crashed add target %d", tgt)
+            continue
         cv = sim.add_acceptor(tgt)
         if vid < nvals:
             sim.propose(0, vid); vid += 1
@@ -228,7 +231,14 @@ def run_member(args) -> int:
         sim.propose(0, vid)
         vid += 1
         sim.run_rounds(2)
-    for tgt in range(n - 1, 0, -1):
+    # Shrink: crashed members first — their removal restores the
+    # live-majority headroom the del guard enforces.
+    for _ in range(2 * n):
+        accs = sim.acceptor_set(0) - {0}
+        if not accs:
+            break
+        dead = sorted(accs & sim.crashed_set())
+        tgt = dead[0] if dead else max(accs)
         cv = sim.del_acceptor(tgt)
         if not sim.run_until(lambda: sim.applied(cv), args.max_rounds):
             logger.error("del_acceptor(%d) never applied", tgt)

@@ -3,10 +3,31 @@
 One loop iteration = one synchronous message exchange — faithful to
 member/'s network, which delivers by calling the peer's ``OnReceive``
 inline (ref member/main.cpp:65-79).  There are no drops or delays in
-this variant (ref member/debug.conf.sample: failure_rate 0); liveness
-needs only the anti-dueling prepare backoff and an accept-staleness
-restart (covering version races, ref Proposer::AcceptorsChanged
-member/paxos.cpp:1862-1908).
+this variant (member/'s network is reliable); liveness needs only the
+anti-dueling prepare backoff and an accept-staleness restart (covering
+version races, ref Proposer::AcceptorsChanged member/paxos.cpp:1862-1908).
+
+Crash injection (the member/ fault model): each live node crashes with
+probability ``crash_rate``/1e6 per round, the round analog of
+``Thread::RandomFailure`` firing with failure_rate/1e6 per log call
+(ref member/indet.h:146-150, member/debug.conf.sample field 3).  A
+crashed node is fail-stop silent: it grants no promises, acks no
+accepts, learns nothing, applies nothing, and proposes nothing.  Its
+entries in everyone's views persist — quorum denominators do NOT
+shrink on crash; only a DEL_ACCEPTOR through the log shrinks them.
+Two deliberate strengthenings over the reference, whose RandomFailure
+aborts the entire simulation process and validates only the replayed
+prefix: (a) crashes here are per-node and the surviving majority keeps
+running (prefix consistency must hold across dead and live logs
+alike), so admission is capped — a crash is only admitted if every
+live node's view retains a live majority of its acceptors — and
+(b) node 0 never crashes, because it plays the reference harness's
+driver role (member/main.cpp proposes and churns through nodes[0]).
+The cap holds at crash time only: a later DEL_ACCEPTOR of a live node
+can shrink a view below live majority, and an ADD_ACCEPTOR of a
+crashed node can inflate the quorum denominator without adding a live
+acceptor — ``MemberSim.add_acceptor``/``del_acceptor`` guard against
+both host-side.
 
 Cluster bootstrap: every node's view starts as {0} in all three role
 sets (ref NodeImpl::Loop, member/paxos.cpp:729-737: only node ``first_``
@@ -65,6 +86,14 @@ _NEG = jnp.int32(jnp.iinfo(jnp.int32).min)
 
 ACCEPT_STALE_ROUNDS = 4  # restart prepare if a batch stalls this long
 
+# Idle-liveness patience (core/sim's IDLE_RESTART_ROUNDS transplanted):
+# an idle live proposer re-prepares after this many rounds whenever the
+# log is unresolved — a hole below the chosen high-water mark, or a
+# value accepted by a live acceptor but never chosen because its
+# proposer crashed mid-accept.  The fresh prepare's adoption re-accepts
+# the orphan and no-op fill plugs the hole.
+REPAIR_STALL_ROUNDS = 8
+
 
 def change_vid(node: int, kind: int) -> int:
     """Encode a membership change as a value id."""
@@ -101,6 +130,7 @@ def membership_suffix(vid: int) -> str | None:
 
 class MemberState(NamedTuple):
     t: jax.Array
+    crashed: jax.Array  # [N] bool fail-stop crash mask
     # per-viewing-node role masks: row v = node v's view
     learners: jax.Array  # [N, N] bool
     proposers: jax.Array  # [N, N] bool
@@ -129,6 +159,7 @@ class MemberState(NamedTuple):
     pend: jax.Array  # [N, C] int32
     head: jax.Array  # [N] int32
     tail: jax.Array  # [N] int32
+    stall: jax.Array  # [N] int32 idle rounds while the log is unresolved
     # decisions
     chosen_vid: jax.Array  # [I] int32
     chosen_round: jax.Array  # [I] int32
@@ -141,6 +172,7 @@ def _init(n: int, i: int, c: int) -> MemberState:
     seed_view = jnp.zeros((n, n), jnp.bool_).at[:, 0].set(True)
     return MemberState(
         t=jnp.int32(0),
+        crashed=jnp.zeros((n,), jnp.bool_),
         learners=seed_view,
         proposers=seed_view,
         acceptors=seed_view,
@@ -165,27 +197,32 @@ def _init(n: int, i: int, c: int) -> MemberState:
         pend=none(n, c),
         head=zero(n),
         tail=zero(n),
+        stall=zero(n),
         chosen_vid=none(i),
         chosen_round=none(i),
         chosen_ballot=none(i),
     )
 
 
-def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
+def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 0):
     idx = jnp.arange(i_cap, dtype=jnp.int32)
     rows = jnp.arange(n)
 
     def round_fn(st: MemberState) -> MemberState:
         t = st.t
-        # node-local roles (a node acts on its OWN view of itself)
-        is_prop = st.proposers[rows, rows]  # [N]
-        is_accp = st.acceptors[rows, rows]  # [N]
+        alive = ~st.crashed  # [N]
+        # node-local roles (a node acts on its OWN view of itself;
+        # crashed nodes act in no role)
+        is_prop = st.proposers[rows, rows] & alive  # [N]
+        is_accp = st.acceptors[rows, rows] & alive  # [N]
         quorum_v = (
             jnp.sum(st.acceptors, axis=1, dtype=jnp.int32) // 2 + 1
-        )  # [N] majority of each node's view
+        )  # [N] majority of each node's view (crashes do NOT shrink it)
 
         # ---------- ACCEPT phase (batches from previously prepared) ----
-        send_acc = st.prepared & jnp.any(st.cur_batch != val.NONE, axis=1)
+        send_acc = (
+            st.prepared & jnp.any(st.cur_batch != val.NONE, axis=1) & alive
+        )
         # version gate: acceptor a processes proposer v iff equal
         # versions (ref member/paxos.cpp:1747) and a is an acceptor in
         # v's view and its own
@@ -234,7 +271,11 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
         n_ack = jnp.sum(
             acks & st.acceptors[:, None, :], axis=-1, dtype=jnp.int32
         )  # [V, I]
-        inst_chosen = w_has & (n_ack >= quorum_v[:, None])
+        # A crashed proposer can no longer detect (or broadcast) a
+        # choice even if its accumulated acks reach quorum; the value
+        # stays accepted-by-quorum until some live proposer re-prepares
+        # and adopts it.
+        inst_chosen = w_has & (n_ack >= quorum_v[:, None]) & alive[:, None]
         newly = inst_chosen & (st.chosen_vid[None] == val.NONE)
         any_new = jnp.any(newly, axis=0)
         new_v = jnp.max(jnp.where(newly, st.cur_batch, _NEG), axis=0)
@@ -246,7 +287,11 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
         # LEARN broadcast (synchronous, to the chooser's view-learners;
         # ref Learner::OnLearn) — chosen values reach every listed
         # learner this round
-        learn_edge = inst_chosen[:, :, None] & st.learners[:, None, :]
+        learn_edge = (
+            inst_chosen[:, :, None]
+            & st.learners[:, None, :]
+            & alive[None, None, :]  # crashed learners learn nothing
+        )
         has_l = jnp.any(learn_edge, axis=0)  # [I, L]
         lv = jnp.max(jnp.where(learn_edge, st.cur_batch[:, :, None], _NEG), axis=0)
         learned = jnp.where(has_l & (st.learned == val.NONE), lv, st.learned)
@@ -266,8 +311,10 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
         )  # [N]
         mine = learned[f, rows]  # [N] nn's own copy at its frontier
         l_at_f = learned[f, :]  # [N, M] row nn = all holders of f[nn]
-        donor_ok = (l_at_f != val.NONE) & st.learners.T  # [nn, m]
-        can_pull = jnp.any(donor_ok, axis=1) & (mine == val.NONE)
+        donor_ok = (
+            (l_at_f != val.NONE) & st.learners.T & alive[None, :]  # [nn, m]
+        )
+        can_pull = jnp.any(donor_ok, axis=1) & (mine == val.NONE) & alive
         pulled = jnp.max(jnp.where(donor_ok, l_at_f, _NEG), axis=1)
         learned = learned.at[f, rows].set(
             jnp.where(can_pull, pulled, mine)
@@ -288,10 +335,14 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
             jnp.cumprod((nonchg | pre).astype(jnp.int32), axis=1), axis=1
         )
         run = jnp.maximum(run_total - fa, 0)  # plain values applied now
+        run = jnp.where(alive, run, 0)  # crashed logs freeze at crash
         f2 = jnp.clip(fa + run, 0, i_cap - 1)
         head_v = learned[f2, rows]  # [N] entry right after the run
         can_apply = (
-            (head_v != val.NONE) & (fa + run < i_cap) & (head_v >= CHANGE_BASE)
+            (head_v != val.NONE)
+            & (fa + run < i_cap)
+            & (head_v >= CHANGE_BASE)
+            & alive
         )
         is_chg = can_apply
         k = jnp.where(is_chg, head_v - CHANGE_BASE, 0)
@@ -350,7 +401,7 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
         # conflict re-proposal / own completion (ref OnLearn conflict
         # path; same semantics as core/sim)
         learned_me = learned.T  # [N, I] each node's own learner column
-        own_has = st.own_assign != val.NONE
+        own_has = (st.own_assign != val.NONE) & alive[:, None]
         conflict = own_has & (learned_me != val.NONE) & (
             learned_me != st.own_assign
         )
@@ -371,11 +422,49 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
         cur_batch = jnp.where(prepared[:, None], cur_batch, val.NONE)
         acks = jnp.where(prepared[:, None, None], acks, False)
 
+        # ---------- idle-liveness repair ----------
+        # Unresolved log: a hole below the chosen high-water mark, or a
+        # value some live acceptor holds accepted that nobody chose
+        # (its proposer crashed mid-accept).  An idle live proposer
+        # restarts its prepare after REPAIR_STALL_ROUNDS; adoption and
+        # no-op fill then resolve both cases.
+        hw = jnp.max(jnp.where(chosen_vid != val.NONE, idx, -1))
+        hole = jnp.any((chosen_vid == val.NONE) & (idx <= hw))
+        # An orphan held only by nodes outside every live node's
+        # current acceptor view is unresolvable (no prepare will ever
+        # reach its holder) — repair must not chase it forever.
+        in_view = jnp.any(acceptors_v & alive[:, None], axis=0)  # [N]
+        orphan = jnp.any(
+            (chosen_vid == val.NONE)
+            & jnp.any(
+                (acc_vid != val.NONE) & alive[None, :] & in_view[None, :],
+                axis=1,
+            )
+        )
+        unresolved = hole | orphan
+        no_work = (st.head >= tail) & jnp.all(own_assign == val.NONE, axis=1)
+        batch_open = jnp.any(
+            (st.cur_batch != val.NONE) & (chosen_vid[None] == val.NONE),
+            axis=1,
+        )
+        idle = is_prop & no_work & ~batch_open
+        stall = jnp.where(idle & unresolved, st.stall + 1, 0)
+        # gate on delay_until so a kick is never consumed without
+        # producing a prepare (want_prep requires t >= delay_until)
+        repair_kick = (
+            is_prop & (stall >= REPAIR_STALL_ROUNDS) & (t >= delay_until)
+        )
+        # re-arm the patience window so a stubborn unresolved log kicks
+        # once per window, not once per round (an every-round kick would
+        # bump the ballot count without bound)
+        stall = jnp.where(repair_kick, 0, stall)
+        prepared = prepared & ~repair_kick
+
         # ---------- PREPARE phase ----------
         committed_me = learned_me != val.NONE  # [N, I]
         has_work = (st.head < tail) | jnp.any(own_assign != val.NONE, axis=1)
         want_prep = (
-            is_prop & ~prepared & has_work & (t >= delay_until)
+            is_prop & ~prepared & (has_work | repair_kick) & (t >= delay_until)
         )
         ncnt, nbal = bal.bump_past(
             st.count, rows.astype(jnp.int32), jnp.maximum(pmax, st.ballot)
@@ -450,7 +539,7 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
 
         # new-value assignment for prepared proposers (first-fit over
         # the open tail; same shape as core/sim but ungated)
-        can_assign = prepared
+        can_assign = prepared & alive
         activity = (
             committed_me | (cur_batch != val.NONE) | (own_assign != val.NONE)
         )
@@ -467,8 +556,34 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
         own_assign = jnp.where(takev, newv, own_assign)
         head = st.head + kk
 
+        # ---------- crash injection ----------
+        # Bernoulli(crash_rate/1e6) per live node per round (ref
+        # member/indet.h:146-150 RandomFailure), admitted one candidate
+        # at a time: a crash is allowed only if every node that would
+        # remain alive keeps a live majority of its own view's
+        # acceptors (the cap that lets survivors keep running where the
+        # reference aborts the whole process).  Node 0 is the harness
+        # driver and never crashes.  Static unroll over candidates — n
+        # is the node count, <= 32 by construction.
+        crashed = st.crashed
+        if crash_rate:
+            ku = prng.stream(root, prng.STREAM_CRASH, t)
+            u = jax.random.randint(ku, (n,), 0, 1_000_000)
+            want = (u < crash_rate) & alive
+            qv_new = jnp.sum(acceptors_v, axis=1, dtype=jnp.int32) // 2 + 1
+            alive_c = alive
+            for x in range(1, n):
+                still = alive_c & (rows != x)
+                live_acc = jnp.sum(
+                    acceptors_v & still[None, :], axis=1, dtype=jnp.int32
+                )
+                ok = jnp.all(~still | (live_acc >= qv_new))
+                alive_c = jnp.where(want[x] & ok, still, alive_c)
+            crashed = ~alive_c
+
         return MemberState(
             t=t + 1,
+            crashed=crashed,
             learners=learners_v,
             proposers=proposers_v,
             acceptors=acceptors_v,
@@ -493,6 +608,7 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array):
             pend=pend,
             head=head,
             tail=tail,
+            stall=stall,
             chosen_vid=chosen_vid,
             chosen_round=chosen_round,
             chosen_ballot=chosen_ballot,
@@ -507,18 +623,30 @@ class MemberSim:
     changes, steps the engine, exposes the Applied predicate and the
     per-node applied logs."""
 
-    def __init__(self, n_nodes: int, n_instances: int, seed: int = 0):
+    def __init__(
+        self,
+        n_nodes: int,
+        n_instances: int,
+        seed: int = 0,
+        crash_rate: int = 0,
+    ):
         self.n = n_nodes
         self.i = n_instances
         self.c = n_instances * 2 + 8
         self.root = prng.root_key(seed)
         self.state = _init(n_nodes, n_instances, self.c)
-        self._round = jax.jit(_build_round(n_nodes, n_instances, self.c, self.root))
+        self._round = jax.jit(
+            _build_round(n_nodes, n_instances, self.c, self.root, crash_rate)
+        )
 
     # -- injection (between rounds, host-side; the reference's
     # Node::Propose / AddAcceptor / DelAcceptor surface) --
     def propose(self, node: int, vid: int) -> None:
         st = self.state
+        if bool(st.crashed[node]):
+            # The reference would have aborted the whole run by now; a
+            # silent enqueue to a dead node would just hang the caller.
+            raise RuntimeError(f"node {node} has crashed; propose elsewhere")
         pos = int(st.tail[node])
         # Reserve n_instances slots of headroom for conflict requeues:
         # assignments only target instances above the committed
@@ -534,15 +662,82 @@ class MemberSim:
             tail=st.tail.at[node].add(1),
         )
 
-    def add_acceptor(self, target: int, via: int = 0) -> int:
+    def add_acceptor(
+        self, target: int, via: int = 0, force: bool = False
+    ) -> int:
+        """Propose adding ``target`` to the acceptor set.
+
+        Guard (host-side, advisory): adding a CRASHED node inflates the
+        quorum denominator without adding a live acceptor — the mirror
+        image of the del_acceptor hazard.  (Adding a live node is
+        always safe: numerator and denominator grow together.)"""
+        if not force and bool(self.state.crashed[target]):
+            raise ValueError(
+                f"node {target} has crashed; adding it would inflate the "
+                "quorum without a live acceptor (or pass force=True)"
+            )
         vid = change_vid(target, ADD_ACCEPTOR)
         self.propose(via, vid)
         return vid
 
-    def del_acceptor(self, target: int, via: int = 0) -> int:
+    def del_acceptor(
+        self, target: int, via: int = 0, force: bool = False
+    ) -> int:
+        """Propose removing ``target`` from the acceptor set.
+
+        Guard (host-side, advisory): deleting a LIVE acceptor while
+        crashed ones remain can shrink the view below a live majority
+        and wedge the cluster — the crash-admission cap only holds at
+        crash time.  Delete crashed members first; ``force=True``
+        overrides (the reference has no such guard because its crashes
+        abort the whole run)."""
+        if not force:
+            acc_new = self._projected_acceptors(via)
+            acc_new[target] = False
+            alive = ~np.asarray(self.state.crashed)
+            q_new = int(acc_new.sum()) // 2 + 1
+            live_new = int((acc_new & alive).sum())
+            if live_new < q_new:
+                raise ValueError(
+                    f"deleting acceptor {target} would leave {live_new} "
+                    f"live acceptors of a {q_new}-quorum view; delete "
+                    "crashed members first (or pass force=True)"
+                )
         vid = change_vid(target, DEL_ACCEPTOR)
         self.propose(via, vid)
         return vid
+
+    def _projected_acceptors(self, via: int) -> np.ndarray:
+        """``via``'s acceptor view with every in-flight membership
+        change applied: chosen-but-unapplied log entries, own
+        assignments in flight, and the pending ring.  The del/add
+        guards check against this projection so pipelined changes
+        queued before any applies can't jointly wedge the cluster."""
+        st = self.state
+        acc = np.asarray(st.acceptors[via]).copy()
+
+        def apply_vid(v: int) -> None:
+            if v < CHANGE_BASE:
+                return
+            tgt, kind = decode_change(v)
+            if kind in (ADD_ACCEPTOR, PROPOSER_TO_ACCEPTOR):
+                acc[tgt] = True
+            elif kind in (DEL_ACCEPTOR, ACCEPTOR_TO_PROPOSER):
+                acc[tgt] = False
+
+        chosen = np.asarray(st.chosen_vid)
+        upto = int(st.applied_upto[via])
+        for v in chosen[upto:]:
+            if v != int(val.NONE):
+                apply_vid(int(v))
+        for v in np.asarray(st.own_assign[via]):
+            if v != int(val.NONE):
+                apply_vid(int(v))
+        pend = np.asarray(st.pend[via])
+        for pos in range(int(st.head[via]), min(int(st.tail[via]), self.c)):
+            if pend[pos] != int(val.NONE):
+                apply_vid(int(pend[pos]))
+        return acc
 
     # -- stepping --
     def run_rounds(self, k: int) -> None:
@@ -585,6 +780,9 @@ class MemberSim:
         upto = int(st.applied_upto[node])
         col = np.asarray(st.learned[:upto, node])
         return col[(col >= 0) & (col < CHANGE_BASE)]
+
+    def crashed_set(self) -> set[int]:
+        return set(np.flatnonzero(np.asarray(self.state.crashed)).tolist())
 
     def acceptor_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.acceptors[viewer])).tolist())
