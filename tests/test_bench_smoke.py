@@ -34,16 +34,24 @@ def _run(env_extra):
 
 
 def test_bench_json_contract():
+    """Smoke the headline path plus the secondary sim record at a tiny
+    size; the heavyweight sharded subprocess records are exercised by
+    the real bench run and skipped here for suite latency."""
     rec = _run(
         {
             "TPU_PAXOS_BENCH_INSTANCES": "4096",
             "TPU_PAXOS_BENCH_REPS": "2",
+            "TPU_PAXOS_BENCH_SIM_INSTANCES": "4096",
+            "TPU_PAXOS_BENCH_SHARDED_CHILD": "0",
         }
     )
     assert rec["metric"] == "paxos_instances_per_sec_to_chosen"
     assert rec["unit"] == "instances/sec"
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
+    sim_recs = [s for s in rec["secondary"] if s.get("engine") == "sim"]
+    assert sim_recs and sim_recs[0]["done"] is True
+    assert sim_recs[0]["rounds_to_chosen"]["p90"] >= 1
 
 
 def test_bench_sharded_mode():
@@ -52,6 +60,7 @@ def test_bench_sharded_mode():
             "TPU_PAXOS_BENCH_INSTANCES": "4096",
             "TPU_PAXOS_BENCH_REPS": "2",
             "TPU_PAXOS_BENCH_SHARDED": "1",
+            "TPU_PAXOS_BENCH_SECONDARY": "0",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         }
     )

@@ -220,6 +220,7 @@ def test_orphaned_accepted_value_repaired_by_idle_proposer():
     # accepted at a low real ballot, nobody chose it, no pending work
     # exists anywhere
     k = int(np.max(np.flatnonzero(np.asarray(st.chosen_vid) != val.NONE))) + 1
+    assert k < ms.i, "setup grew past capacity; the injection would clamp"
     orphan_ballot = (1 << 16) | 1
     ms.state = st._replace(
         acc_ballot=st.acc_ballot.at[k, 1].set(orphan_ballot),
@@ -299,14 +300,12 @@ def test_churn_with_crashes_survivors_progress():
         c = ms.add_acceptor(tgt)
         assert ms.run_until(lambda: ms.applied(c), max_rounds=3000), tgt
 
-    # Shrink back to {0}: dead members first (their removal restores
-    # live-majority headroom), then live ones.
+    # Shrink back to {0} in the engine's safe order (crashed members
+    # first — see MemberSim.next_shrink_target).
     for _ in range(2 * n):
-        accs = ms.acceptor_set(0) - {0}
-        if not accs:
+        tgt = ms.next_shrink_target()
+        if tgt is None:
             break
-        dead = sorted(accs & ms.crashed_set())
-        tgt = dead[0] if dead else max(accs)
         c = ms.del_acceptor(tgt)
         assert ms.run_until(lambda: ms.applied(c), max_rounds=3000), tgt
     assert ms.acceptor_set(0) == {0}

@@ -231,14 +231,13 @@ def run_member(args) -> int:
         sim.propose(0, vid)
         vid += 1
         sim.run_rounds(2)
-    # Shrink: crashed members first — their removal restores the
-    # live-majority headroom the del guard enforces.
+    # Shrink: MemberSim.next_shrink_target orders crashed members
+    # first, restoring the live-majority headroom the del guard
+    # enforces.
     for _ in range(2 * n):
-        accs = sim.acceptor_set(0) - {0}
-        if not accs:
+        tgt = sim.next_shrink_target()
+        if tgt is None:
             break
-        dead = sorted(accs & sim.crashed_set())
-        tgt = dead[0] if dead else max(accs)
         cv = sim.del_acceptor(tgt)
         if not sim.run_until(lambda: sim.applied(cv), args.max_rounds):
             logger.error("del_acceptor(%d) never applied", tgt)

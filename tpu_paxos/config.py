@@ -120,6 +120,11 @@ class SimConfig:
     # Hard cap on simulated rounds (liveness watchdog, not a protocol
     # knob).  The scan exits early once every instance is chosen.
     max_rounds: int = 10_000
+    # Queue entries a proposer may assign per round (static first-fit
+    # window).  The default suits correctness runs; large-instance
+    # throughput runs raise it — assignment rate is assign_window per
+    # proposer per round at O(window^2) one-hot cost.
+    assign_window: int = 64
     protocol: ProtocolConfig = dataclasses.field(default_factory=ProtocolConfig)
     faults: FaultConfig = dataclasses.field(default_factory=FaultConfig)
 
@@ -128,6 +133,8 @@ class SimConfig:
             raise ValueError("n_nodes must be >= 1")
         if self.n_instances < 1:
             raise ValueError("n_instances must be >= 1")
+        if self.assign_window < 1:
+            raise ValueError("assign_window must be >= 1")
         props = self.proposers or (0,)
         object.__setattr__(self, "proposers", tuple(sorted(set(props))))
         for p in self.proposers:

@@ -95,11 +95,6 @@ COMMITTED_BALLOT = jnp.int32(2**30)
 
 _NEG = jnp.int32(jnp.iinfo(jnp.int32).min)  # -inf sentinel for masked max
 
-# How many queue entries a proposer may assign per round (static
-# window for the gated-assignment scan; re-proposals and large
-# workloads simply take extra rounds).
-ASSIGN_WINDOW = 64
-
 # Idle-liveness patience: a PREPARED proposer with nothing in flight
 # while the log still has holes (or unlearned chosen values) restarts
 # its prepare after this many rounds, so holes and undelivered commits
@@ -249,7 +244,7 @@ def _select_by_argmax(values_pi, cand_pia):
     return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
 
 
-def _assignable_window(pend, gate, head, tail, chosen_vid, c):
+def _assignable_window(pend, gate, head, tail, chosen_vid, c, w):
     """First-fit view of the head window: which of the next W queue
     entries are live and gate-satisfied.  Gated entries (the in-order
     client seam, ref multi/main.cpp:398-401: next value only after the
@@ -257,8 +252,17 @@ def _assignable_window(pend, gate, head, tail, chosen_vid, c):
     reference's propose queue is a set, and a conflict-requeued value
     must be able to run ahead of entries gated on it.
 
+    Under sharding the gate test stays purely LOCAL (this shard's gate
+    vids against this shard's chosen slice): ``split_workload`` places
+    every gated entry on the shard of its gate's value, and conflict
+    requeues stay on their shard, so a gate's predecessor is always
+    chosen on this shard or not at all.  A cross-shard reduction here
+    would be wrong anyway — window slots of different shards hold
+    unrelated queue entries, so a positional OR mixes meanings (and
+    would let the NONE sentinel match unchosen instances).
+
     Returns (qpos [P, W] ring positions, qvid [P, W], ok [P, W])."""
-    offs = jnp.arange(ASSIGN_WINDOW)
+    offs = jnp.arange(w)
     qpos = jnp.clip(head[:, None] + offs[None], 0, c - 1)  # [P, W] absolute
     live = ((head[:, None] + offs[None]) < tail[:, None]) & (
         jnp.take_along_axis(pend, qpos, axis=1) != val.NONE
@@ -272,21 +276,64 @@ def _assignable_window(pend, gate, head, tail, chosen_vid, c):
     return qpos, qvid, ok
 
 
-def build_engine(cfg: SimConfig, n_pend_cap: int):
+def build_engine(
+    cfg: SimConfig,
+    n_pend_cap: int,
+    axis_name: str | None = None,
+    n_shards: int = 1,
+):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
-    the state; everything shape-like is baked in."""
+    the state; everything shape-like is baked in.
+
+    With ``axis_name`` set, the round function is the per-shard body of
+    an instance-axis ``shard_map``: every [.., I, ..] array it sees is
+    a shard of ``n_instances // n_shards`` instances (with the queue
+    arrays per-shard private), instance indices are globalized via
+    ``lax.axis_index``, and the handful of places where instance-axis
+    information crosses shards — high-water marks, send predicates,
+    gate membership, quiescence — become ``pmax``/``psum`` collectives
+    over ICI.  All [P]/[A]-shaped protocol state stays replicated: its
+    updates are functions of replicated network arrivals and these
+    global reductions, so every shard computes identical copies (the
+    sharded-vs-unsharded equivalence test pins this).
+    """
     a, i_cap = cfg.n_nodes, cfg.n_instances
     p = len(cfg.proposers)
     c = n_pend_cap
     quorum = cfg.quorum
     pc, fc = cfg.protocol, cfg.faults
     pn = jnp.asarray(cfg.proposers, jnp.int32)  # [P] proposer -> node
-    idx = jnp.arange(i_cap, dtype=jnp.int32)
+    if i_cap % n_shards:
+        raise ValueError(f"n_instances {i_cap} not divisible by {n_shards}")
+    i_loc = i_cap // n_shards  # instances per shard ([I]-axis array size)
     max_crash = (a - 1) // 2
+
+    if axis_name is None:
+        def gmax(x):
+            return x
+
+        def gany(b):
+            return b
+    else:
+        def gmax(x):
+            return jax.lax.pmax(x, axis_name)
+
+        def gany(b):
+            return jax.lax.pmax(b.astype(jnp.int32), axis_name).astype(bool)
+
+    def gall(b):
+        return ~gany(~b)
 
     def round_fn(root: jax.Array, st: SimState) -> SimState:
         t = st.t
+        if axis_name is None:
+            off = jnp.int32(0)
+        else:
+            off = (jax.lax.axis_index(axis_name) * i_loc).astype(jnp.int32)
+        # global instance ids of this shard (noop encoding, high-water
+        # ordering, and the decision log all use global ids)
+        idx = off + jnp.arange(i_loc, dtype=jnp.int32)
         s = st.net.prep_req.shape[0]
         slot = t % s
         ar = jax.tree.map(lambda b: b[slot], st.net)
@@ -381,13 +428,13 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
         promises2 = pr.promises | match.T  # [P, A]
         repb = jnp.where(
-            match.T[:, None, :], jnp.broadcast_to(snap_b[None], (p, i_cap, a)),
+            match.T[:, None, :], jnp.broadcast_to(snap_b[None], (p, i_loc, a)),
             bal.NONE,
         )  # [P, I, A]
         best_a = jnp.argmax(repb, axis=-1)  # [P, I]
         best_b = jnp.max(repb, axis=-1)  # [P, I]
         best_v = jnp.take_along_axis(
-            jnp.broadcast_to(snap_v[None], (p, i_cap, a)), best_a[..., None],
+            jnp.broadcast_to(snap_v[None], (p, i_loc, a)), best_a[..., None],
             axis=-1,
         )[..., 0]
         take = (best_b != bal.NONE) & (best_b > pr.adopted_b)
@@ -404,7 +451,25 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         committed_p = (learned[:, :] != val.NONE)[:, pn].T  # [P, I]
         use_adopt = ~committed_p & (adopted_b != bal.NONE)
         covered0 = committed_p | use_adopt
-        hi = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)  # [P]
+        # Hole-fill frontier: local while this shard still has values
+        # to place (their space below the global frontier is capacity,
+        # not holes); extended to the global frontier only once EVERY
+        # proposer's queue on this shard is drained — the shard's
+        # instance space is shared, so one drained proposer must not
+        # noop-fill space another proposer's queued values need, and
+        # all-drained also implies no future conflict requeue can ever
+        # re-open a queue here (conflicts need a live own_assign).
+        # Then each shard's region closes with no-ops and global
+        # contiguity (the apply frontier, quiescence) is reached.
+        # Unsharded: gmax is identity — hi is the usual frontier.
+        hi_loc = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)  # [P]
+        # crashed proposers are excused (their queues are dead, exactly
+        # as q_empty excuses them) or the shard could never close
+        drained = (
+            (pr.head >= pr.tail)
+            & jnp.all(pr.own_assign == val.NONE, axis=1)
+        ) | ~prop_alive  # [P] this shard's queue fully placed
+        hi = jnp.where(jnp.all(drained), gmax(hi_loc), hi_loc)
         below = idx[None] <= hi[:, None]
         noop_fill = below & ~covered0
         own_has = pr.own_assign != val.NONE
@@ -436,10 +501,15 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         activity = (
             committed_p | (cur_batch != val.NONE) | (pr.own_assign != val.NONE)
         )
+        # Assignment frontier is shard-LOCAL: each shard first-fits its
+        # own queue onto its own lowest free instances (placement
+        # differs from the unsharded engine; safety and the chosen
+        # multiset do not — see parallel/sharded_sim.py).
         hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)  # [P]
         free = idx[None] > hi2[:, None]  # [P, I]
         qpos, qvid, ok = _assignable_window(
-            pr.pend, pr.gate, pr.head, pr.tail, st.met.chosen_vid, c
+            pr.pend, pr.gate, pr.head, pr.tail, st.met.chosen_vid, c,
+            cfg.assign_window,
         )
         ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1  # [P, W]
         free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
@@ -447,7 +517,7 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         k = jnp.where(can_assign, k, 0)
         take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
         # vid of the r-th taken entry, gatherable by free_rank
-        w = ASSIGN_WINDOW
+        w = cfg.assign_window
         rank_oh = (
             ok_rank[:, :, None] == jnp.arange(w)[None, None, :]
         ) & take_q[:, :, None]  # [P, W, R]
@@ -475,7 +545,7 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         head = pr.head + jnp.sum(
             jnp.cumprod(lead_dead.astype(jnp.int32), axis=1), axis=1
         )
-        added = k > 0
+        added = gany(k > 0)  # any shard assigned -> (re)send accepts
 
         # ACCEPT_REPLY arrivals: per-instance acks for current ballot,
         # derived at delivery: the acceptor currently holds this
@@ -528,7 +598,7 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         )
         resend_c = (t >= pr.commit_deadline)[:, None] & not_all_acked
         send_commit_i = (newly | resend_c) & prop_alive[:, None]  # [P, I]
-        send_commit = jnp.any(send_commit_i, axis=1)
+        send_commit = gany(jnp.any(send_commit_i, axis=1))
         commit_deadline = jnp.where(
             send_commit, t + 1 + pc.commit_retry_timeout, pr.commit_deadline
         )
@@ -572,7 +642,7 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         )
         adl = (
             (mode == PREPARED)
-            & jnp.any(outstanding, axis=1)
+            & gany(jnp.any(outstanding, axis=1))
             & (t >= acc_deadline)
             & prop_alive
         )
@@ -622,8 +692,11 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         adopted_v = jnp.where(start_prep[:, None], val.NONE, adopted_v)
 
         send_prep = start_prep | resend_prep
-        send_accept = (now_prepared | added | resend_acc) & jnp.any(
-            cur_batch != val.NONE, axis=1
+        # gany: the network calendars are replicated, so the send
+        # predicate must agree across shards even when only some
+        # shards' batches have content
+        send_accept = (now_prepared | added | resend_acc) & gany(
+            jnp.any(cur_batch != val.NONE, axis=1)
         )
 
         # ---------------- network writes ----------------
@@ -711,17 +784,17 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         # ---------------- quiescence ----------------
         alive2 = ~crashed
         palive2 = alive2[pn]
-        q_empty = jnp.all((head == tail) | ~palive2)
-        own_none = jnp.all((own_assign == val.NONE) | ~palive2[:, None])
-        hmax = jnp.max(
+        q_empty = gall(jnp.all((head == tail) | ~palive2))
+        own_none = gall(jnp.all((own_assign == val.NONE) | ~palive2[:, None]))
+        hmax = gmax(jnp.max(
             jnp.where(met.chosen_vid != val.NONE, idx, -1)
-        )
-        contiguous = jnp.all(
+        ))
+        contiguous = gall(jnp.all(
             (met.chosen_vid != val.NONE) | (idx > hmax)
-        )
-        learned_ok = jnp.all(
+        ))
+        learned_ok = gall(jnp.all(
             (learned != val.NONE) | crashed[None, :] | (idx[:, None] > hmax)
-        )
+        ))
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
 
         # Stall accounting for the idle-liveness restart: a proposer is
@@ -734,10 +807,10 @@ def build_engine(cfg: SimConfig, n_pend_cap: int):
         inflight = (cur_batch != val.NONE) & (met.chosen_vid[None] == val.NONE)
         idle_now = (
             (mode == PREPARED)
-            & ~jnp.any(inflight, axis=1)
-            & ~jnp.any(not_all_acked, axis=1)  # commit repair in flight
-            & (head == tail)
-            & jnp.all(own_assign == val.NONE, axis=1)
+            & ~gany(jnp.any(inflight, axis=1))
+            & ~gany(jnp.any(not_all_acked, axis=1))  # commit repair in flight
+            & gall(head == tail)
+            & gall(jnp.all(own_assign == val.NONE, axis=1))
             & palive2
         )
         stall = jnp.where(idle_now & unresolved & ~done, pr.stall + 1, 0)

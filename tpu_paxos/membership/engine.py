@@ -784,6 +784,17 @@ class MemberSim:
     def crashed_set(self) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.crashed)).tolist())
 
+    def next_shrink_target(self, viewer: int = 0) -> int | None:
+        """The safe deletion order when shrinking back to {0}: crashed
+        acceptors first (their removal restores live-majority headroom
+        — the policy the del_acceptor guard enforces), then the highest
+        live one.  None once only node 0 remains."""
+        accs = self.acceptor_set(viewer) - {0}
+        if not accs:
+            return None
+        dead = sorted(accs & self.crashed_set())
+        return dead[0] if dead else max(accs)
+
     def acceptor_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.acceptors[viewer])).tolist())
 

@@ -1,0 +1,287 @@
+"""Instance-axis sharded general engine (shard_map over core/sim).
+
+The full protocol ladder — retries, faults, crashes, hole-filling,
+conflict re-proposal, in-order gates — runs sharded: ``core/sim``'s
+``round_fn`` is built with an ``axis_name`` and becomes the per-shard
+body of a ``shard_map`` over the instance axis (BASELINE config 4's
+shape: 7-node, 100M instances over a v5e-8 slice).  This is the
+scale-out the reference reaches with one thread per node over
+in-process queues (ref multi/main.cpp:51-162) — here each shard owns a
+contiguous block of instances and the cross-shard traffic is a handful
+of scalar/[P]-sized ``pmax``/``psum`` reductions per round over ICI.
+
+Sharding layout:
+- ``[I, A]`` / ``[P, I]`` / ``[P, I, A]`` protocol arrays: split over
+  the instance axis.
+- ``[P]`` / ``[A]`` scalars and the network calendars: replicated —
+  their updates are functions of replicated arrivals plus the global
+  reductions, so every shard computes identical copies.
+- Queue state (``pend``/``gate``/``head``/``tail``): per-shard
+  *private* — each proposer's workload is round-robin split across
+  shards and each shard first-fit-assigns its own queue onto its own
+  free instances.  Assignment order therefore differs from the
+  unsharded engine (values land at shard-local lowest-free instances,
+  not global), which changes *placement*, never *safety*: the
+  invariant checks (agreement, exactly-once, in-order gates) and the
+  chosen-value multiset are placement-independent, and the reference
+  itself never pins values to instances (``AvailableInstanceIDs.Next``
+  is just "some free id", ref multi/paxos.cpp:253-318).
+- Conflict re-proposals requeue into the conflicting shard's own
+  queue, so the per-shard capacity proof of ``prepare_queues`` holds
+  with ``i_local`` headroom.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_paxos.config import SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.core import values as val
+from tpu_paxos.parallel.mesh import INSTANCE_AXIS
+from tpu_paxos.utils import prng
+
+_I = P(INSTANCE_AXIS)
+
+
+def _state_specs() -> simm.SimState:
+    """PartitionSpec pytree for SimState under the instance mesh."""
+    return simm.SimState(
+        t=P(),
+        acc=simm.AcceptorState(
+            promised=P(), max_seen=P(), acc_ballot=_I, acc_vid=_I
+        ),
+        learned=_I,
+        prop=simm.ProposerState(
+            mode=P(),
+            count=P(),
+            ballot=P(),
+            pmax_seen=P(),
+            delay_until=P(),
+            prep_deadline=P(),
+            prep_retries=P(),
+            promises=P(),
+            adopted_b=P(None, INSTANCE_AXIS),
+            adopted_v=P(None, INSTANCE_AXIS),
+            cur_batch=P(None, INSTANCE_AXIS),
+            acks=P(None, INSTANCE_AXIS, None),
+            acc_deadline=P(),
+            acc_retries=P(),
+            own_assign=P(None, INSTANCE_AXIS),
+            # leading axis = shard (per-shard private queues)
+            pend=P(INSTANCE_AXIS, None, None),
+            gate=P(INSTANCE_AXIS, None, None),
+            head=P(INSTANCE_AXIS, None),
+            tail=P(INSTANCE_AXIS, None),
+            commit_vid=P(None, INSTANCE_AXIS),
+            commit_acked=P(None, INSTANCE_AXIS, None),
+            commit_deadline=P(),
+            stall=P(),
+        ),
+        net=jax.tree.map(lambda _: P(), simm.netm.init_buffers(1, 1, 1)),
+        met=simm.Metrics(
+            chosen_vid=_I, chosen_round=_I, chosen_ballot=_I, msgs=P()
+        ),
+        crashed=P(),
+        done=P(),
+    )
+
+
+def _unwrap(st: simm.SimState) -> simm.SimState:
+    """Strip the leading shard axis from the per-shard queue leaves
+    (local block [1, P, C] -> [P, C]) so round_fn sees its usual
+    shapes."""
+    pr = st.prop
+    return st._replace(
+        prop=pr._replace(
+            pend=pr.pend[0], gate=pr.gate[0], head=pr.head[0], tail=pr.tail[0]
+        )
+    )
+
+
+def _wrap(st: simm.SimState) -> simm.SimState:
+    pr = st.prop
+    return st._replace(
+        prop=pr._replace(
+            pend=pr.pend[None],
+            gate=pr.gate[None],
+            head=pr.head[None],
+            tail=pr.tail[None],
+        )
+    )
+
+
+def split_workload(
+    workload: list[np.ndarray],
+    gates: list[np.ndarray] | None,
+    n_shards: int,
+):
+    """Chain-aware round-robin split of each proposer's (vid, gate)
+    sequence over shards; returns per-shard workload/gates lists.
+
+    A gated entry is placed on the shard where its gate's value was
+    placed (whatever entry that gate points at — immediate
+    predecessor, branching fan-out, or another proposer's value): the
+    executed-order guarantee relies on assignment monotonicity, which
+    holds within a shard's region (per-proposer frontiers include all
+    committed instances) but not across regions.  Ungated entries —
+    and entries whose gate vid is not in any already-placed workload
+    entry — start fresh groups round-robined over shards."""
+    nonev = int(val.NONE)
+    wls = [[[] for _ in workload] for _ in range(n_shards)]
+    gts = [[[] for _ in workload] for _ in range(n_shards)]
+    placed: dict[int, int] = {}  # vid -> shard
+    nxt = 0
+    for pi, w in enumerate(workload):
+        w = np.asarray(w, np.int32)
+        g = (
+            np.full(len(w), nonev, np.int32)
+            if gates is None or not len(gates[pi])
+            else np.asarray(gates[pi], np.int32)
+        )
+        for k in range(len(w)):
+            shard = placed.get(int(g[k])) if g[k] != nonev else None
+            if shard is None:
+                shard = nxt % n_shards
+                nxt += 1
+            placed[int(w[k])] = shard
+            wls[shard][pi].append(int(w[k]))
+            gts[shard][pi].append(int(g[k]))
+    to_np = lambda seqs: [np.asarray(s, np.int32) for s in seqs]  # noqa: E731
+    return (
+        [to_np(wl) for wl in wls],
+        None if gates is None else [to_np(gt) for gt in gts],
+    )
+
+
+def prepare_queues_sharded(
+    cfg: SimConfig,
+    workload: list[np.ndarray],
+    gates: list[np.ndarray] | None,
+    n_shards: int,
+):
+    """Per-shard queue arrays: returns (pend [D, P, C], gate [D, P, C],
+    tail [D, P], c) with a uniform capacity C sized by the largest
+    shard-local workload plus ``i_local`` requeue headroom (the
+    per-shard version of ``prepare_queues``'s capacity proof)."""
+    p = len(cfg.proposers)
+    i_loc = cfg.n_instances // n_shards
+    wls, gts = split_workload(workload, gates, n_shards)
+    c = max(
+        max((len(w) for w in wl), default=0) for wl in wls
+    ) + i_loc + 8
+    pend = np.full((n_shards, p, c), int(val.NONE), np.int32)
+    gate = np.full((n_shards, p, c), int(val.NONE), np.int32)
+    tail = np.zeros((n_shards, p), np.int32)
+    for s in range(n_shards):
+        for pi, wl in enumerate(wls[s]):
+            pend[s, pi, : len(wl)] = wl
+            tail[s, pi] = len(wl)
+            if gts is not None and len(gts[s][pi]):
+                g = gts[s][pi]
+                gate[s, pi, : len(g)] = g
+    return pend, gate, tail, c
+
+
+def init_sharded_state(
+    cfg: SimConfig, mesh: Mesh, pend, gate, tail, root: jax.Array
+) -> simm.SimState:
+    """Global SimState laid out over the mesh (queue leaves carry the
+    leading shard axis)."""
+    p = len(cfg.proposers)
+    dummy = np.full((p, pend.shape[2]), int(val.NONE), np.int32)
+    st = simm.init_state(cfg, dummy, dummy, np.zeros((p,), np.int32), root)
+    st = st._replace(
+        prop=st.prop._replace(
+            pend=jnp.asarray(pend),
+            gate=jnp.asarray(gate),
+            head=jnp.zeros(tail.shape, jnp.int32),
+            tail=jnp.asarray(tail),
+        )
+    )
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        _state_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.tree.map(jax.device_put, st, shardings)
+
+
+def build_runner(
+    cfg: SimConfig,
+    mesh: Mesh,
+    workload: list[np.ndarray] | None = None,
+    gates: list[np.ndarray] | None = None,
+):
+    """Compile-once runner: returns ``(fn, root, state, expected)``
+    where ``fn(root, state) -> final state`` is the jitted shard_map'd
+    whole-run loop.  Benchmarks call ``fn`` twice to time steady-state
+    without compilation."""
+    d = mesh.size
+    if cfg.n_instances % d:
+        raise ValueError(
+            f"n_instances ({cfg.n_instances}) must divide over {d} devices"
+        )
+    if workload is None:
+        workload = simm.default_workload(cfg)
+    pend, gate, tail, c = prepare_queues_sharded(cfg, workload, gates, d)
+    root = prng.root_key(cfg.seed)
+    state = init_sharded_state(cfg, mesh, pend, gate, tail, root)
+    round_fn = simm.build_engine(cfg, c, axis_name=INSTANCE_AXIS, n_shards=d)
+
+    def body(root, st):
+        st = _unwrap(st)
+
+        def cond(s):
+            return (~s.done) & (s.t < cfg.max_rounds)
+
+        def step(s):
+            return round_fn(root, s)
+
+        return _wrap(jax.lax.while_loop(cond, step, st))
+
+    specs = _state_specs()
+    mapped = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), specs),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )
+    expected = np.unique(
+        np.concatenate(
+            [np.asarray(w, np.int32).reshape(-1) for w in workload]
+        )
+    )
+    return mapped, root, state, expected
+
+
+def to_result(final: simm.SimState, expected: np.ndarray) -> simm.SimResult:
+    return simm.SimResult(
+        learned=np.asarray(final.learned),
+        chosen_vid=np.asarray(final.met.chosen_vid),
+        chosen_round=np.asarray(final.met.chosen_round),
+        chosen_ballot=np.asarray(final.met.chosen_ballot),
+        rounds=int(final.t),
+        done=bool(final.done),
+        crashed=np.asarray(final.crashed),
+        msgs=np.asarray(final.met.msgs),
+        expected_vids=expected,
+    )
+
+
+def run_sharded(
+    cfg: SimConfig,
+    mesh: Mesh,
+    workload: list[np.ndarray] | None = None,
+    gates: list[np.ndarray] | None = None,
+) -> simm.SimResult:
+    """Drive the general engine to quiescence with the instance axis
+    sharded over ``mesh`` — the sharded twin of ``core.sim.run``."""
+    fn, root, state, expected = build_runner(cfg, mesh, workload, gates)
+    return to_result(fn(root, state), expected)
