@@ -320,10 +320,21 @@ def main() -> None:
     total.block_until_ready()
     assert int(total) == n_inst * reps, f"warmup chose {int(total)}"
 
-    t0 = time.perf_counter()
-    state3, total = step(state2, vids0)
-    total.block_until_ready()
-    dt = time.perf_counter() - t0
+    # Optional profiler capture of the timed window
+    # (TPU_PAXOS_BENCH_PROFILE=<dir>; view with tensorboard/xprof).
+    import contextlib
+
+    profile_dir = os.environ.get("TPU_PAXOS_BENCH_PROFILE", "")
+    trace = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+    with trace:
+        t0 = time.perf_counter()
+        state3, total = step(state2, vids0)
+        total.block_until_ready()
+        dt = time.perf_counter() - t0
 
     n_chosen = int(total)
     assert n_chosen == n_inst * reps, f"bench chose {n_chosen}"

@@ -1,0 +1,92 @@
+"""Checkpoint/resume equivalence — a capability the reference lacks
+(it has no persistence at all; SURVEY.md §5): a run interrupted at
+round k, saved, restored into a fresh state skeleton, and continued
+must produce a byte-identical outcome to the uninterrupted run."""
+
+import numpy as np
+import pytest
+
+from tpu_paxos import checkpoint
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import sim
+from tpu_paxos.membership import MemberSim
+from tpu_paxos.utils import prng
+
+import jax
+
+
+def _setup(cfg):
+    workload = sim.default_workload(cfg)
+    pend, gate, tail, c = sim.prepare_queues(cfg, workload)
+    root = prng.root_key(cfg.seed)
+    state = sim.init_state(cfg, pend, gate, tail, root)
+    expected = np.unique(np.concatenate([np.asarray(w) for w in workload]))
+    return workload, pend, gate, tail, c, root, state, expected
+
+
+def test_resume_equivalence_mid_run(tmp_path):
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=64,
+        proposers=(0, 1),
+        seed=7,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+    _, pend, gate, tail, c, root, state, expected = _setup(cfg)
+    round_fn = sim.build_engine(cfg, c)
+    step = jax.jit(lambda s: round_fn(root, s))
+    for _ in range(12):  # interrupt mid-protocol, well before quiescence
+        state = step(state)
+    assert not bool(state.done)
+
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, state, {"seed": cfg.seed, "round": int(state.t)})
+
+    # uninterrupted continuation
+    full = sim.run_state(cfg, state, root, expected, c)
+
+    # restore into a fresh structural skeleton and continue
+    like = sim.init_state(cfg, pend, gate, tail, root)
+    restored, meta = checkpoint.restore(path, like)
+    assert meta["round"] == 12
+    resumed = sim.run_state(cfg, restored, root, expected, c)
+
+    assert resumed.done and full.done
+    assert np.array_equal(resumed.chosen_vid, full.chosen_vid)
+    assert np.array_equal(resumed.chosen_round, full.chosen_round)
+    assert np.array_equal(resumed.chosen_ballot, full.chosen_ballot)
+    assert np.array_equal(resumed.learned, full.learned)
+    assert resumed.rounds == full.rounds
+
+    # and both equal the never-interrupted from-scratch run
+    scratch = sim.run(cfg)
+    assert np.array_equal(resumed.chosen_vid, scratch.chosen_vid)
+    assert np.array_equal(resumed.learned, scratch.learned)
+
+
+def test_restore_refuses_mismatched_config(tmp_path):
+    cfg = SimConfig(n_nodes=3, n_instances=32, proposers=(0,), seed=0)
+    _, pend, gate, tail, c, root, state, _ = _setup(cfg)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, state)
+
+    other = SimConfig(n_nodes=5, n_instances=32, proposers=(0,), seed=0)
+    _, p2, g2, t2, c2, r2, like, _ = _setup(other)
+    with pytest.raises(ValueError, match="wrong config"):
+        checkpoint.restore(path, like)
+
+
+def test_member_state_roundtrip_mid_churn(tmp_path):
+    """Membership engine state checkpoints the same way (it is just a
+    pytree); a restored sim continues the churn to completion."""
+    ms = MemberSim(n_nodes=3, n_instances=32, seed=0)
+    cv = ms.add_acceptor(1)
+    ms.run_rounds(2)  # change in flight, not yet applied
+
+    path = str(tmp_path / "member.npz")
+    checkpoint.save(path, ms.state)
+
+    ms2 = MemberSim(n_nodes=3, n_instances=32, seed=0)
+    ms2.state, _ = checkpoint.restore(path, ms2.state)
+    assert ms2.run_until(lambda: ms2.applied(cv), max_rounds=400)
+    assert ms2.acceptor_set(0) == {0, 1}

@@ -1,0 +1,117 @@
+"""tpu_paxos.native — C++ fast-path equivalence vs the pure-Python
+reference implementations (the native library builds on demand with
+g++; these tests fail rather than skip if the toolchain is missing,
+because this environment guarantees g++)."""
+
+import numpy as np
+import pytest
+
+from tpu_paxos import native
+from tpu_paxos.core import values as val
+from tpu_paxos.harness import validate
+from tpu_paxos.replay.decision_log import decision_log as render_log
+
+NONE = int(val.NONE)
+
+
+def test_native_builds():
+    assert native.available(), "g++ build of tpu_paxos.native failed"
+
+
+def _random_learned(rng, i=2000, a=5, holes=0.3):
+    """Consistent learned array: one chosen value per instance,
+    revealed to a random subset of nodes."""
+    # distinct real values (exactly-once must hold by construction)
+    chosen = rng.choice(4 * i, size=i, replace=False).astype(np.int32)
+    chosen[rng.random(i) < 0.1] = NONE  # undecided instances
+    know = rng.random((i, a)) > holes
+    learned = np.where(know & (chosen != NONE)[:, None], chosen[:, None], NONE)
+    return learned.astype(np.int32), chosen
+
+
+def test_agreement_equivalence():
+    rng = np.random.default_rng(0)
+    learned, _ = _random_learned(rng)
+    assert native.check_agreement(learned) is None
+    validate.check_agreement(learned)  # python path agrees (small size)
+
+    # inject a violation; both paths must catch the same instance
+    bad = learned.copy()
+    row = np.flatnonzero((bad != NONE).sum(axis=1) >= 2)[7]
+    cols = np.flatnonzero(bad[row] != NONE)
+    bad[row, cols[1]] = bad[row, cols[0]] + 1
+    assert native.check_agreement(bad) == row
+    with pytest.raises(validate.InvariantViolation, match=f"instance {row}"):
+        validate.check_agreement(bad)
+
+
+def test_chosen_per_instance_equivalence():
+    rng = np.random.default_rng(1)
+    learned, chosen = _random_learned(rng)
+    nat = native.chosen_per_instance(learned)
+    py = validate._chosen_per_instance(learned)
+    assert np.array_equal(nat, py)
+    visible = (learned != NONE).any(axis=1)
+    assert np.array_equal(nat[visible], chosen[visible])
+
+
+def test_check_unique_both_paths():
+    chosen = np.asarray([5, NONE, 9, -7, 12], np.int32)  # -7 = noop
+    assert native.check_unique(chosen) is None
+    assert native.check_unique(chosen, max_vid=100) is None
+    dup = np.asarray([5, 9, 5], np.int32)
+    assert native.check_unique(dup) == 5
+    assert native.check_unique(dup, max_vid=100) == 5
+
+
+def test_decision_log_equivalence():
+    """Native renderer output is byte-identical to the Python
+    renderer's for real + no-op vids."""
+    rng = np.random.default_rng(2)
+    i, stride = 3000, 100_000
+    cv = np.full(i, NONE, np.int32)
+    cb = np.full(i, NONE, np.int32)
+    decided = rng.random(i) < 0.8
+    cv[decided] = (
+        rng.integers(0, 4, size=decided.sum()) * stride
+        + rng.integers(0, 1000, size=decided.sum())
+    ).astype(np.int32)
+    noop = decided & (rng.random(i) < 0.2)
+    cv[noop] = val.NOOP_BASE - rng.integers(0, 4 * i, size=noop.sum()).astype(
+        np.int32
+    )
+    cb[decided] = rng.integers(1, 1 << 20, size=decided.sum()).astype(np.int32)
+
+    py = render_log(cv, cb, stride=stride, n_instances=i)
+    nat = native.render_decision_log(cv, cb, stride=stride, n_instances=i)
+    assert nat == py
+
+
+def test_validate_routes_large_arrays_through_native():
+    """Above the size threshold check_agreement uses the C++ path and
+    still reports violations through the same exception."""
+    rng = np.random.default_rng(3)
+    learned, _ = _random_learned(rng, i=40_000, a=5)
+    assert learned.size >= validate._NATIVE_MIN_CELLS
+    validate.check_agreement(learned)
+    validate.check_exactly_once(learned)
+    bad = learned.copy()
+    row = np.flatnonzero((bad != NONE).sum(axis=1) >= 2)[0]
+    cols = np.flatnonzero(bad[row] != NONE)
+    bad[row, cols[1]] += 1
+    with pytest.raises(validate.InvariantViolation, match="agreement"):
+        validate.check_agreement(bad)
+
+
+def test_native_scale_smoke():
+    """1M-instance validation + render completes via the native path
+    (this is the load the numpy/Python paths choke on at 10^8)."""
+    i, a = 1 << 20, 5
+    chosen = np.arange(i, dtype=np.int32)
+    learned = np.broadcast_to(chosen[:, None], (i, a)).copy()
+    assert native.check_agreement(learned) is None
+    assert native.check_unique(chosen, max_vid=i) is None
+    out = native.render_decision_log(
+        chosen[: 1 << 16], chosen[: 1 << 16] % 7, stride=1 << 30, n_instances=i
+    )
+    assert out.count("\n") == 1 << 16

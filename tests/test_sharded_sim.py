@@ -157,6 +157,27 @@ def test_split_workload_branching_and_cross_proposer_gates():
     assert shard_of[30] == shard_of[10]
 
 
+def test_split_workload_forward_and_cross_proposer_reference():
+    """A gate may reference a value that appears LATER in the scan
+    (proposer 0's entry gated on proposer 1's value): union-find
+    grouping must still co-locate them.  The old first-pass placement
+    round-robined the gated entry before seeing its gate, stranding it
+    on a shard where the gate never chooses — a permanent wedge."""
+    wl = [np.asarray([20], np.int32), np.asarray([10], np.int32)]
+    gates = [np.asarray([10], np.int32), np.asarray([int(val.NONE)], np.int32)]
+    wls, _ = sharded_sim.split_workload(wl, gates, 2)
+    shard_of = {
+        v: s for s in range(2) for pi in range(2) for v in wls[s][pi].tolist()
+    }
+    assert shard_of[20] == shard_of[10]
+    # and the whole run completes
+    m = pmesh.make_instance_mesh()
+    cfg = SimConfig(n_nodes=3, n_instances=64, proposers=(0, 1), seed=0)
+    r = sharded_sim.run_sharded(cfg, m, workload=wl, gates=gates)
+    _check(r)
+    assert sorted(v for v in r.chosen_vid.tolist() if v >= 0) == [10, 20]
+
+
 def test_sharded_sim_seed4_no_wedge():
     """Regression: an early-drained proposer must not noop-fill shard
     space another proposer's conflict-requeued values still need (the

@@ -67,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default="sim")
     p.add_argument("--json", action="store_true",
                    help="emit a JSON summary instead of the verdict line")
+    p.add_argument("--trace-dir", type=str, default="",
+                   help="write a jax profiler trace of the engine run "
+                   "here (view with tensorboard/xprof)")
+    p.add_argument("--save-state", type=str, default="",
+                   help="dump the run's decision tensors (chosen/learned/"
+                   "metrics arrays) to this .npz path")
     return p
 
 
@@ -123,7 +129,24 @@ def run_sim(args) -> int:
         "sim: %d nodes, %d clients x %d ids, seed %d",
         args.srvcnt, args.cltcnt, args.idcnt, args.seed,
     )
-    res = sim.run(cfg, workload, gates)
+    if args.mesh:
+        import dataclasses
+
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.parallel import sharded_sim
+
+        # build the mesh first: it may have fewer devices than
+        # requested, and the padding must match its actual size
+        mesh = pmesh.make_instance_mesh(args.mesh)
+        pad = (-cfg.n_instances) % mesh.size
+        if pad:
+            cfg = dataclasses.replace(cfg, n_instances=cfg.n_instances + pad)
+        logger.info("instance axis sharded over %d devices", mesh.size)
+        runner = lambda: sharded_sim.run_sharded(cfg, mesh, workload, gates)  # noqa: E731
+    else:
+        runner = lambda: sim.run(cfg, workload, gates)  # noqa: E731
+    res = _with_trace(args, runner)
+    _maybe_save_result(args, res, logger)
     sys.stdout.write(
         render_log(
             res.chosen_vid, res.chosen_ballot,
@@ -278,6 +301,39 @@ def _level(args) -> int:
     from tpu_paxos.utils import log as logm
 
     return logm.parse_level(args.log_level)
+
+
+def _with_trace(args, runner):
+    """Run ``runner`` under a jax profiler trace when --trace-dir is
+    set (the bench-harness profiling hook; view with tensorboard)."""
+    if not args.trace_dir:
+        return runner()
+    import jax
+
+    with jax.profiler.trace(args.trace_dir):
+        return runner()
+
+
+def _maybe_save_result(args, res, logger) -> None:
+    """--save-state: dump the run's decision tensors (the trace-dump
+    analog of the reference's final committed-log print,
+    ref multi/paxos.cpp:1694-1703) to an .npz."""
+    if not args.save_state:
+        return
+    import numpy as np
+
+    np.savez(
+        args.save_state,
+        chosen_vid=res.chosen_vid,
+        chosen_round=res.chosen_round,
+        chosen_ballot=res.chosen_ballot,
+        learned=res.learned,
+        crashed=res.crashed,
+        msgs=res.msgs,
+        rounds=np.int64(res.rounds),
+        done=np.bool_(res.done),
+    )
+    logger.info("decision tensors saved to %s", args.save_state)
 
 
 def _emit(args, summary: dict) -> None:

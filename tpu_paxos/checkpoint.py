@@ -1,0 +1,70 @@
+"""Checkpoint / resume: whole-system state save and restore.
+
+The reference has NO persistence — all protocol state is in-memory and
+a crash loses every promise (SURVEY.md §5 notes this as a real-world
+gap; the indet replay logs record the *schedule*, not a state
+snapshot).  Here the entire system — acceptors, proposers, learners,
+network calendars, metrics, crash masks — is one pytree of arrays, so
+checkpointing is a flat array dump and resume is exact: the round
+function is pure and every PRNG stream is a function of (seed, tag,
+round), so a resumed run continues bit-identically to an uninterrupted
+one (pinned by tests/test_checkpoint.py).
+
+Works for any engine state pytree (core.sim.SimState,
+membership.engine.MemberState, core.fast.FastState).  The treedef is
+not serialized — the caller supplies a structurally identical example
+(e.g. a freshly built initial state for the same config), which also
+guards against restoring into a mismatched geometry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+_META = "tpu_paxos_meta"
+
+
+def save(path: str, state, meta: dict | None = None) -> None:
+    """Write a state pytree (plus optional JSON-able metadata) to one
+    ``.npz`` file."""
+    leaves = jax.tree.leaves(state)
+    payload = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    payload[_META] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic: no torn checkpoints
+
+
+def restore(path: str, like):
+    """Rebuild the pytree saved at ``path`` using ``like``'s structure.
+    Returns ``(state, meta)``.  Shapes and dtypes must match ``like``'s
+    leaves exactly — a mismatch means the checkpoint belongs to a
+    different config and is refused."""
+    structure = jax.tree.structure(like)
+    ref_leaves = jax.tree.leaves(like)
+    with np.load(path) as z:
+        n = len([k for k in z.files if k.startswith("leaf_")])
+        if n != len(ref_leaves):
+            raise ValueError(
+                f"checkpoint has {n} leaves, expected {len(ref_leaves)} — "
+                "wrong config or engine for this checkpoint"
+            )
+        leaves = []
+        for i, ref in enumerate(ref_leaves):
+            arr = z[f"leaf_{i}"]
+            ref = np.asarray(ref)
+            if arr.shape != ref.shape or arr.dtype != ref.dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i} is {arr.dtype}{list(arr.shape)}, "
+                    f"expected {ref.dtype}{list(ref.shape)} — wrong config"
+                )
+            leaves.append(arr)
+        meta = json.loads(bytes(z[_META]).decode()) if _META in z.files else {}
+    return jax.tree.unflatten(structure, leaves), meta

@@ -9,9 +9,12 @@ asserts each node's applied log is a prefix of node 0's
 (ref member/main.cpp:260-265).
 
 These are the framework's correctness gates: every engine run finishes
-by calling into this module (numpy — vectorized, fast enough for
-multi-million-instance logs).  ``reference_runner.check_parity`` runs
-the same checks against the C++ reference binary's parsed logs, so one
+by calling into this module.  Large logs route through
+``tpu_paxos.native``'s single-pass C++ scans (built on demand; the
+numpy implementations below remain the reference semantics and the
+fallback, with native/python equivalence pinned by
+tests/test_native.py).  ``reference_runner.check_parity`` runs the
+same checks against the C++ reference binary's parsed logs, so one
 checker judges both systems.
 """
 
@@ -31,10 +34,25 @@ def _fail(msg: str) -> None:
     raise InvariantViolation(msg)
 
 
+# Route the O(I*A) scans through tpu_paxos.native's single-pass C++
+# above this size (below it, numpy/ctypes overheads wash out).
+_NATIVE_MIN_CELLS = 1 << 16
+
+
+def _use_native(learned: np.ndarray) -> bool:
+    from tpu_paxos import native
+
+    return learned.size >= _NATIVE_MIN_CELLS and native.available()
+
+
 def _chosen_per_instance(learned: np.ndarray) -> np.ndarray:
     """Per instance: the vid learned by any knowing node (max over
     knowing nodes), or NONE where no node knows a value."""
     learned = np.asarray(learned)
+    if _use_native(learned):
+        from tpu_paxos import native
+
+        return native.chosen_per_instance(learned)
     known = learned != int(val.NONE)
     best = np.where(known, learned, np.iinfo(np.int32).min).max(axis=1)
     return np.where(known.any(axis=1), best, int(val.NONE))
@@ -46,6 +64,16 @@ def check_agreement(learned: np.ndarray) -> None:
     asserts it per-commit at multi/paxos.cpp:1509-1510 and whole-run at
     multi/main.cpp:567-570)."""
     learned = np.asarray(learned)
+    if _use_native(learned):
+        from tpu_paxos import native
+
+        bad_i = native.check_agreement(learned)
+        if bad_i is not None:
+            _fail(
+                f"agreement violated at instance {bad_i}: nodes learned "
+                f"{learned[bad_i].tolist()}"
+            )
+        return
     known = learned != int(val.NONE)
     ref_col = _chosen_per_instance(learned)
     bad = (known & (learned != ref_col[:, None])).any(axis=1)
@@ -65,11 +93,24 @@ def check_exactly_once(
     value exactly once (ref multi/main.cpp:571-573: executed ids sorted
     equal 0..N-1)."""
     chosen = _chosen_per_instance(learned)
-    real = chosen[chosen >= 0]
-    uniq, counts = np.unique(real, return_counts=True)
-    if (counts > 1).any():
-        v = int(uniq[np.flatnonzero(counts > 1)[0]])
-        _fail(f"value {v} chosen for more than one instance")
+    if _use_native(np.asarray(learned)):
+        # single-pass C++ duplicate scan in BOTH branches; only the
+        # expected-set comparison below stays in numpy
+        from tpu_paxos import native
+
+        dup = native.check_unique(chosen)
+        if dup is not None:
+            _fail(f"value {dup} chosen for more than one instance")
+        if expected_vids is None:
+            return
+        real = chosen[chosen >= 0]
+        uniq = np.unique(real)
+    else:
+        real = chosen[chosen >= 0]
+        uniq, counts = np.unique(real, return_counts=True)
+        if (counts > 1).any():
+            v = int(uniq[np.flatnonzero(counts > 1)[0]])
+            _fail(f"value {v} chosen for more than one instance")
     if expected_vids is not None:
         expected = np.unique(np.asarray(expected_vids))
         missing = np.setdiff1d(expected, uniq)

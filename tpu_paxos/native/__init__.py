@@ -1,0 +1,152 @@
+"""``tpu_paxos.native`` — C++ host-side fast paths via ctypes.
+
+Compiled on first use (g++, same toolchain discipline as the
+reference's one-line Makefiles, ref multi/Makefile:1-2) into
+``build/native/`` next to the repo root; importers call
+``available()`` and fall back to the pure-Python implementations when
+the toolchain or the build is unavailable, so the framework never
+*requires* native code — it just gets fast validation and log
+rendering at multi-million-instance scale when it can.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "validate.cpp")
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_BUILD_DIR = os.path.join(_REPO, "build", "native")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libtpupaxos.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed: str | None = None
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if not (
+        os.path.exists(_LIB_PATH)
+        and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
+    ):
+        # per-process unique tmp + atomic replace: concurrent first
+        # builds (bench parent + child, parallel pytest) must never
+        # interleave g++ output into one corrupt .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+            os.replace(tmp, _LIB_PATH)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, subprocess.CalledProcessError) as e:
+            _failed = str(e)
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.tp_check_agreement.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.tp_check_agreement.restype = ctypes.c_int
+        lib.tp_chosen_per_instance.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int64, i32p,
+        ]
+        lib.tp_chosen_per_instance.restype = None
+        lib.tp_check_unique.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.tp_check_unique.restype = ctypes.c_int
+        lib.tp_render_decision_log.argtypes = [
+            i32p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.tp_render_decision_log.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is (or can be) loaded."""
+    return _load() is not None
+
+
+def check_agreement(learned: np.ndarray) -> int | None:
+    """First instance where two nodes learned different values, or
+    None when all replicas agree."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    learned = np.ascontiguousarray(learned, np.int32)
+    bad = ctypes.c_int64(-1)
+    rc = lib.tp_check_agreement(
+        learned, learned.shape[0], learned.shape[1], ctypes.byref(bad)
+    )
+    return int(bad.value) if rc else None
+
+
+def chosen_per_instance(learned: np.ndarray) -> np.ndarray:
+    lib = _load()
+    assert lib is not None, "call available() first"
+    learned = np.ascontiguousarray(learned, np.int32)
+    out = np.empty(learned.shape[0], np.int32)
+    lib.tp_chosen_per_instance(learned, learned.shape[0], learned.shape[1], out)
+    return out
+
+
+def check_unique(chosen: np.ndarray, max_vid: int = -1) -> int | None:
+    """A real vid chosen at two instances, or None when exactly-once
+    holds.  ``max_vid >= 0`` enables the dense-bitset fast path."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    chosen = np.ascontiguousarray(chosen, np.int32)
+    dup = ctypes.c_int32(-1)
+    rc = lib.tp_check_unique(chosen, len(chosen), max_vid, ctypes.byref(dup))
+    return int(dup.value) if rc else None
+
+
+def render_decision_log(
+    chosen_vid: np.ndarray,
+    chosen_ballot: np.ndarray,
+    stride: int,
+    n_instances: int,
+) -> str:
+    """The reference value grammar (ref multi/paxos.cpp:18-22) for
+    real + no-op vids.  Membership-change vids need the host intern
+    table — callers with those use the Python renderer."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    cv = np.ascontiguousarray(chosen_vid, np.int32)
+    cb = np.ascontiguousarray(chosen_ballot, np.int32)
+    need = lib.tp_render_decision_log(
+        cv, cb, len(cv), stride, n_instances, None, 0
+    )
+    if need == 0:
+        return ""
+    buf = ctypes.create_string_buffer(need)
+    wrote = lib.tp_render_decision_log(
+        cv, cb, len(cv), stride, n_instances, buf, need
+    )
+    assert wrote == need
+    return buf.raw[:need].decode()

@@ -122,19 +122,20 @@ def split_workload(
     """Chain-aware round-robin split of each proposer's (vid, gate)
     sequence over shards; returns per-shard workload/gates lists.
 
-    A gated entry is placed on the shard where its gate's value was
-    placed (whatever entry that gate points at — immediate
-    predecessor, branching fan-out, or another proposer's value): the
-    executed-order guarantee relies on assignment monotonicity, which
-    holds within a shard's region (per-proposer frontiers include all
-    committed instances) but not across regions.  Ungated entries —
-    and entries whose gate vid is not in any already-placed workload
-    entry — start fresh groups round-robined over shards."""
+    A gated entry must land on the shard where its gate's value lands
+    (whatever entry that gate points at — immediate predecessor,
+    branching fan-out, a forward reference, or another proposer's
+    value): the executed-order guarantee relies on assignment
+    monotonicity, which holds within a shard's region (per-proposer
+    frontiers include all committed instances) but not across regions,
+    and the engine's gate test is shard-local.  Entries are therefore
+    grouped into connected components of the gate graph (union-find)
+    and whole components round-robin over shards.  Gates referencing
+    vids outside the workload leave their entry in its own component
+    (such gates never satisfy, exactly as unsharded)."""
     nonev = int(val.NONE)
-    wls = [[[] for _ in workload] for _ in range(n_shards)]
-    gts = [[[] for _ in workload] for _ in range(n_shards)]
-    placed: dict[int, int] = {}  # vid -> shard
-    nxt = 0
+    entries = []  # (pi, vid, gate) in scan order
+    vid_pos: dict[int, int] = {}
     for pi, w in enumerate(workload):
         w = np.asarray(w, np.int32)
         g = (
@@ -143,13 +144,35 @@ def split_workload(
             else np.asarray(gates[pi], np.int32)
         )
         for k in range(len(w)):
-            shard = placed.get(int(g[k])) if g[k] != nonev else None
-            if shard is None:
-                shard = nxt % n_shards
-                nxt += 1
-            placed[int(w[k])] = shard
-            wls[shard][pi].append(int(w[k]))
-            gts[shard][pi].append(int(g[k]))
+            vid_pos.setdefault(int(w[k]), len(entries))
+            entries.append((pi, int(w[k]), int(g[k])))
+
+    parent = list(range(len(entries)))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for e, (_, _, gv) in enumerate(entries):
+        if gv != nonev and gv in vid_pos:
+            ra, rb = find(e), find(vid_pos[gv])
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+
+    shard_of_root: dict[int, int] = {}
+    wls = [[[] for _ in workload] for _ in range(n_shards)]
+    gts = [[[] for _ in workload] for _ in range(n_shards)]
+    nxt = 0
+    for e, (pi, v, gv) in enumerate(entries):
+        r = find(e)
+        if r not in shard_of_root:
+            shard_of_root[r] = nxt % n_shards
+            nxt += 1
+        shard = shard_of_root[r]
+        wls[shard][pi].append(v)
+        gts[shard][pi].append(gv)
     to_np = lambda seqs: [np.asarray(s, np.int32) for s in seqs]  # noqa: E731
     return (
         [to_np(wl) for wl in wls],

@@ -43,6 +43,17 @@ def decision_log(
     """
     chosen_vid = np.asarray(chosen_vid)
     chosen_ballot = np.asarray(chosen_ballot)
+    # Large plain logs (no custom payload/membership rendering) go
+    # through the native C++ renderer — same grammar, one pass, no
+    # per-line Python string work.  Equivalence pinned by
+    # tests/test_native.py.
+    if payload is None and membership is None and len(chosen_vid) >= 1 << 14:
+        from tpu_paxos import native
+
+        if native.available():
+            return native.render_decision_log(
+                chosen_vid, chosen_ballot, stride, n_instances
+            )
     lines = []
     for i in range(len(chosen_vid)):
         v = int(chosen_vid[i])
