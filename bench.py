@@ -143,13 +143,15 @@ def bench_sim_record() -> dict:
     from tpu_paxos.core import sim as simm
     from tpu_paxos.utils import prng
 
-    i = int(os.environ.get("TPU_PAXOS_BENCH_SIM_INSTANCES", 1 << 17))
+    i = int(os.environ.get("TPU_PAXOS_BENCH_SIM_INSTANCES", 1 << 20))
     cfg = SimConfig(
         n_nodes=5,
         n_instances=i,
         proposers=(0, 1),
         seed=0,
-        assign_window=1024,
+        # wide first-fit window: assignment is W vids/proposer/round at
+        # O(W) cost since the rank scatter replaced the O(W^2) one-hot
+        assign_window=max(256, min(1 << 16, i // 8)),
         max_rounds=20_000,
         faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
     )
@@ -239,7 +241,7 @@ def bench_sharded_child() -> list[dict]:
         n_instances=i,
         proposers=(0, 1),
         seed=0,
-        assign_window=1024,
+        assign_window=max(256, min(1 << 14, i // (8 * n_dev))),
         max_rounds=20_000,
         faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
     )

@@ -205,6 +205,21 @@ def test_stale_version_proposer_blocked_until_catchup():
     _check_prefix(ms, 3)
 
 
+def test_in_order_client_host_gated():
+    """member/'s in-order seam: the host proposes each value only
+    after the previous one is chosen (the driver pattern of ref
+    member/main.cpp:138-140), and the applied order matches proposal
+    order — values land while churn is in flight."""
+    ms = MemberSim(n_nodes=3, n_instances=32, seed=0)
+    c = ms.add_acceptor(1)
+    chain = [300, 301, 302, 303]
+    assert ms.propose_in_order(0, chain)
+    assert ms.run_until(lambda: ms.applied(c), max_rounds=800)
+    log = ms.applied_log(0).tolist()
+    assert [v for v in log if v in chain] == chain
+    _check_prefix(ms, 2)
+
+
 def test_orphaned_accepted_value_repaired_by_idle_proposer():
     """A value accepted by a live acceptor whose proposer died before
     choosing it must still be chosen: an idle live proposer's

@@ -62,6 +62,11 @@ def test_check_unique_both_paths():
     dup = np.asarray([5, 9, 5], np.int32)
     assert native.check_unique(dup) == 5
     assert native.check_unique(dup, max_vid=100) == 5
+    # a stale/too-small bound must never yield a false-clean verdict:
+    # out-of-range vids fall back to the unbounded sort path
+    over = np.asarray([150, 150], np.int32)
+    assert native.check_unique(over, max_vid=100) == 150
+    assert native.check_unique(np.asarray([150, 99], np.int32), max_vid=100) is None
 
 
 def test_decision_log_equivalence():

@@ -150,6 +150,28 @@ def test_in_order_client_gating():
     validate.check_in_order_clients(executed, [vids])
 
 
+def test_run_state_derives_gate_cap():
+    """run_state without an explicit vid_cap must still enforce gates
+    (derived from the state's own gate array) — a gate-bearing state
+    silently run ungated would choose the whole chain at once."""
+    from tpu_paxos.utils import prng
+
+    vids = np.asarray([10, 11, 12, 13], np.int32)
+    gates = [np.asarray([int(val.NONE), 10, 11, 12], np.int32)]
+    cfg = SimConfig(n_nodes=3, n_instances=16, proposers=(0,), seed=0)
+    pend, gate, tail, c = sim.prepare_queues(cfg, [vids], gates)
+    root = prng.root_key(cfg.seed)
+    st = sim.init_state(cfg, pend, gate, tail, root)
+    r = sim.run_state(cfg, st, root, vids, c)  # no vid_cap passed
+    assert r.done
+    rounds_of = {
+        int(v): int(rr)
+        for v, rr in zip(r.chosen_vid, r.chosen_round)
+        if v >= 0
+    }
+    assert rounds_of[10] < rounds_of[11] < rounds_of[12] < rounds_of[13]
+
+
 def test_in_order_under_faults_and_contention():
     """In-order client on proposer 0 while proposer 1 floods free
     values, under reference fault rates — order must still hold."""

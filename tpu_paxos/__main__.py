@@ -193,19 +193,28 @@ def run_fast(args) -> int:
     n = args.cltcnt * args.idcnt
     quorum = args.srvcnt // 2 + 1
     vids = jnp.arange(n, dtype=jnp.int32)
-    if args.mesh:
-        from tpu_paxos.parallel import mesh as pmesh
-        from tpu_paxos.parallel import sharded
+    def _go():
+        if args.mesh:
+            from tpu_paxos.parallel import mesh as pmesh
+            from tpu_paxos.parallel import sharded
 
-        mesh = pmesh.make_instance_mesh(args.mesh)
-        state = sharded.init_sharded_state(mesh, n, args.srvcnt)
-        step = sharded.sharded_choose_all(mesh, proposer=0, quorum=quorum)
-        state, n_chosen = step(state, pmesh.shard_instances(mesh, vids))
-    else:
-        state = fast.init_state(n, args.srvcnt)
-        state, n_chosen = fast.choose_all_jit(
-            state, vids, proposer=0, quorum=quorum
+            mesh = pmesh.make_instance_mesh(args.mesh)
+            st = sharded.init_sharded_state(mesh, n, args.srvcnt)
+            step = sharded.sharded_choose_all(mesh, proposer=0, quorum=quorum)
+            return step(st, pmesh.shard_instances(mesh, vids))
+        st = fast.init_state(n, args.srvcnt)
+        return fast.choose_all_jit(st, vids, proposer=0, quorum=quorum)
+
+    state, n_chosen = _with_trace(args, _go)
+    if args.save_state:
+        np.savez(
+            args.save_state,
+            learned=np.asarray(state.learned),
+            acc_ballot=np.asarray(state.acc_ballot),
+            acc_vid=np.asarray(state.acc_vid),
+            n_chosen=np.int64(int(n_chosen)),
         )
+        logger.info("decision tensors saved to %s", args.save_state)
     ok = True
     try:
         validate.check_all(np.asarray(state.learned), np.arange(n))
@@ -226,6 +235,10 @@ def run_member(args) -> int:
     """member/ churn scenario: grow the cluster from 1 to srvcnt
     acceptors, propose cltcnt*idcnt values meanwhile, shrink back, and
     validate prefix consistency (ref member/main.cpp:101-161, 260-265)."""
+    return _with_trace(args, lambda: _run_member_body(args))
+
+
+def _run_member_body(args) -> int:
     from tpu_paxos.harness import validate
     from tpu_paxos.membership import engine as mem
     from tpu_paxos.utils import log as logm
@@ -286,6 +299,13 @@ def run_member(args) -> int:
     except validate.InvariantViolation as e:
         ok = False
         logger.error("invariant violated: %s", e)
+    if args.save_state:
+        from tpu_paxos import checkpoint
+
+        checkpoint.save(
+            args.save_state, sim.state, {"engine": "member", "seed": args.seed}
+        )
+        logger.info("member state saved to %s", args.save_state)
     _emit(args, {
         "engine": "member",
         "rounds": int(sim.state.t),

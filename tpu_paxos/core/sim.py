@@ -244,7 +244,7 @@ def _select_by_argmax(values_pi, cand_pia):
     return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
 
 
-def _assignable_window(pend, gate, head, tail, chosen_vid, c, w):
+def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
     """First-fit view of the head window: which of the next W queue
     entries are live and gate-satisfied.  Gated entries (the in-order
     client seam, ref multi/main.cpp:398-401: next value only after the
@@ -261,6 +261,13 @@ def _assignable_window(pend, gate, head, tail, chosen_vid, c, w):
     unrelated queue entries, so a positional OR mixes meanings (and
     would let the NONE sentinel match unchosen instances).
 
+    ``chosen_mask`` is a [vid_cap] bool chosen-membership bitmap (or
+    None for gate-free runs, eliding gate logic entirely): a direct
+    ``g == chosen_vid`` compare materializes an O(W * I) intermediate
+    — 17 GB/round at W=1024, I=1M, the single largest tensor in the
+    profile — while the bitmap gather is O(W) on top of the O(I)
+    scatter its caller pays once per round.
+
     Returns (qpos [P, W] ring positions, qvid [P, W], ok [P, W])."""
     offs = jnp.arange(w)
     qpos = jnp.clip(head[:, None] + offs[None], 0, c - 1)  # [P, W] absolute
@@ -268,10 +275,15 @@ def _assignable_window(pend, gate, head, tail, chosen_vid, c, w):
         jnp.take_along_axis(pend, qpos, axis=1) != val.NONE
     )
     qvid = jnp.take_along_axis(pend, qpos, axis=1)
+    if chosen_mask is None:
+        return qpos, qvid, live
     g = jnp.take_along_axis(gate, qpos, axis=1)  # [P, W]
-    g_chosen = jnp.any(
-        g[:, :, None] == chosen_vid[None, None, :], axis=-1
-    ) & (g != val.NONE)
+    v_cap = chosen_mask.shape[0]
+    g_chosen = (
+        chosen_mask[jnp.clip(g, 0, v_cap - 1)]
+        & (g != val.NONE)
+        & (g < v_cap)  # gates on out-of-workload vids never satisfy
+    )
     ok = live & ((g == val.NONE) | g_chosen)
     return qpos, qvid, ok
 
@@ -281,6 +293,7 @@ def build_engine(
     n_pend_cap: int,
     axis_name: str | None = None,
     n_shards: int = 1,
+    vid_cap: int = 0,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
@@ -507,8 +520,16 @@ def build_engine(
         # multiset do not — see parallel/sharded_sim.py).
         hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)  # [P]
         free = idx[None] > hi2[:, None]  # [P, I]
+        if vid_cap:
+            # chosen-vid membership bitmap for the gate test (only
+            # True scatters; invalid indices routed out of range)
+            chosen_mask = jnp.zeros((vid_cap,), jnp.bool_).at[
+                jnp.where(st.met.chosen_vid >= 0, st.met.chosen_vid, vid_cap)
+            ].set(True, mode="drop")
+        else:
+            chosen_mask = None  # gate-free run: no gate logic at all
         qpos, qvid, ok = _assignable_window(
-            pr.pend, pr.gate, pr.head, pr.tail, st.met.chosen_vid, c,
+            pr.pend, pr.gate, pr.head, pr.tail, chosen_mask, c,
             cfg.assign_window,
         )
         ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1  # [P, W]
@@ -516,13 +537,15 @@ def build_engine(
         k = jnp.minimum(jnp.sum(ok, axis=1), jnp.sum(free, axis=1))
         k = jnp.where(can_assign, k, 0)
         take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
-        # vid of the r-th taken entry, gatherable by free_rank
+        # vid of the r-th taken entry, gatherable by free_rank: an O(W)
+        # rank scatter (taken entries have distinct ranks; untaken
+        # slots are routed out of range and dropped) — an equality
+        # one-hot here would cost O(W^2) and cap the window size
         w = cfg.assign_window
-        rank_oh = (
-            ok_rank[:, :, None] == jnp.arange(w)[None, None, :]
-        ) & take_q[:, :, None]  # [P, W, R]
-        by_rank = jnp.max(
-            jnp.where(rank_oh, qvid[:, :, None], _NEG), axis=1
+        prow = jnp.arange(p)[:, None]
+        rank_pos = jnp.where(take_q, ok_rank, w)  # [P, W]
+        by_rank = jnp.full((p, w), _NEG, jnp.int32).at[prow, rank_pos].set(
+            qvid, mode="drop"
         )  # [P, R]
         takev = free & (free_rank < k[:, None])  # instances filled
         newv = jnp.take_along_axis(
@@ -534,7 +557,6 @@ def build_engine(
         # taken ring slots; untaken window positions are redirected out
         # of range and dropped), then advance head over the leading
         # consumed run
-        prow = jnp.arange(p)[:, None]
         pos_taken = jnp.where(take_q, qpos, c)
         pend = pr.pend.at[prow, pos_taken].set(
             jnp.full_like(qpos, val.NONE), mode="drop"
@@ -899,6 +921,22 @@ def prepare_queues(
     return pend, gate, tail, c
 
 
+def gates_vid_cap(
+    workload: list[np.ndarray], gates: list[np.ndarray] | None
+) -> int:
+    """Static vid-space bound for the gate-membership bitmap: 0 when
+    the run has no gates (eliding gate logic entirely), else one past
+    the largest workload vid — gates reference workload values, and a
+    gate on anything larger can never be satisfied, matching the
+    semantics of gating on a value that is never proposed."""
+    if gates is None or all(
+        g is None or not len(g) or (np.asarray(g) == int(val.NONE)).all()
+        for g in gates
+    ):
+        return 0
+    return max(int(np.max(w)) for w in workload if len(w)) + 1
+
+
 def init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
     """Public initial-state constructor (tests seed custom acceptor
     state through this)."""
@@ -913,9 +951,22 @@ def run_state(
     root: jax.Array,
     expected_vids: np.ndarray,
     queue_cap: int,
+    vid_cap: int | None = None,
 ) -> SimResult:
-    """Drive a prepared SimState to quiescence (or cfg.max_rounds)."""
-    round_fn = build_engine(cfg, queue_cap)
+    """Drive a prepared SimState to quiescence (or cfg.max_rounds).
+
+    ``vid_cap`` sizes the gate-membership bitmap; ``None`` (default)
+    derives it from the state's own gate/pend arrays so gate-bearing
+    states are never silently run ungated.  Pass 0 explicitly for a
+    known gate-free run."""
+    if vid_cap is None:
+        gate_np = np.asarray(state.prop.gate)
+        if (gate_np != int(val.NONE)).any():
+            pend_np = np.asarray(state.prop.pend)
+            vid_cap = int(max(pend_np.max(), gate_np.max())) + 1
+        else:
+            vid_cap = 0
+    round_fn = build_engine(cfg, queue_cap, vid_cap=vid_cap)
 
     @jax.jit
     def _go(root, state):
@@ -961,4 +1012,6 @@ def run(
     expected = np.unique(
         np.concatenate([np.asarray(w, np.int32).reshape(-1) for w in workload])
     )
-    return run_state(cfg, state, root, expected, c)
+    return run_state(
+        cfg, state, root, expected, c, vid_cap=gates_vid_cap(workload, gates)
+    )

@@ -55,6 +55,18 @@ Applied semantics: a chosen value is *Applied* once a majority of the
 driver waits for Applied before issuing the next change
 (ref member/main.cpp:138-140) — ``MemberSim.applied`` exposes exactly
 this predicate.
+
+Ordering and scale intent: member/'s reference harness has no
+in-order clients (that is multi/'s workload, covered by core/sim's
+gate arrays); its only ordering constraint is the host driver waiting
+on Applied/chosen between dependent proposals — the same pattern
+``MemberSim.run_until`` provides, and
+``MemberSim.propose_in_order`` packages (see
+tests/test_membership.py).  This engine is the *control-plane*
+variant: churn events are rare and host-paced, so it optimizes for
+reconfiguration semantics, not instance throughput — bulk data-plane
+consensus at scale is core/sim + parallel/sharded_sim, whose
+benchmarks carry the throughput story.
 """
 
 from __future__ import annotations
@@ -661,6 +673,22 @@ class MemberSim:
             pend=st.pend.at[node, pos].set(vid),
             tail=st.tail.at[node].add(1),
         )
+
+    def propose_in_order(
+        self, node: int, vids, max_rounds_each: int = 2000
+    ) -> bool:
+        """In-order client: propose each vid only after the previous
+        one is chosen (the host-gating pattern the reference driver
+        uses for dependent proposals, ref member/main.cpp:138-140;
+        multi/'s in-order clients are the core/sim gate arrays).
+        Returns True when every value was chosen in order."""
+        for v in vids:
+            self.propose(node, int(v))
+            if not self.run_until(
+                lambda: self.chosen(int(v)), max_rounds=max_rounds_each
+            ):
+                return False
+        return True
 
     def add_acceptor(
         self, target: int, via: int = 0, force: bool = False

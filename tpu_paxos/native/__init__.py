@@ -117,12 +117,17 @@ def chosen_per_instance(learned: np.ndarray) -> np.ndarray:
 
 def check_unique(chosen: np.ndarray, max_vid: int = -1) -> int | None:
     """A real vid chosen at two instances, or None when exactly-once
-    holds.  ``max_vid >= 0`` enables the dense-bitset fast path."""
+    holds.  ``max_vid >= 0`` enables the dense-bitset fast path; a
+    vid above the bound transparently falls back to the sort path, so
+    the verdict never depends on the bound being right."""
     lib = _load()
     assert lib is not None, "call available() first"
     chosen = np.ascontiguousarray(chosen, np.int32)
     dup = ctypes.c_int32(-1)
     rc = lib.tp_check_unique(chosen, len(chosen), max_vid, ctypes.byref(dup))
+    if rc == 2:  # bound too small for the data — retry unbounded
+        dup = ctypes.c_int32(-1)
+        rc = lib.tp_check_unique(chosen, len(chosen), -1, ctypes.byref(dup))
     return int(dup.value) if rc else None
 
 

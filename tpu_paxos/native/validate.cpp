@@ -71,7 +71,9 @@ void tp_chosen_per_instance(const int32_t* learned, int64_t n_instances,
 // Exactly-once: no real (vid >= 0) value appears at two instances.
 // chosen is [I].  Returns 0 when clean; 1 and the duplicated vid via
 // *dup_vid otherwise.  Uses a bitset over the dense vid space when
-// max_vid is provided (>= 0), else a sorted vector.
+// max_vid is provided (>= 0), else a sorted vector.  A vid above
+// max_vid returns 2 (bound too small) rather than being silently
+// skipped — the caller retries without the bound.
 int tp_check_unique(const int32_t* chosen, int64_t n_instances,
                     int64_t max_vid, int32_t* dup_vid) {
   if (max_vid >= 0) {
@@ -79,13 +81,15 @@ int tp_check_unique(const int32_t* chosen, int64_t n_instances,
     for (int64_t i = 0; i < n_instances; ++i) {
       const int32_t v = chosen[i];
       if (v < 0) continue;  // NONE or no-op
-      if (v <= max_vid) {
-        if (seen[v]) {
-          *dup_vid = v;
-          return 1;
-        }
-        seen[v] = 1;
+      if (v > max_vid) {
+        *dup_vid = v;
+        return 2;
       }
+      if (seen[v]) {
+        *dup_vid = v;
+        return 1;
+      }
+      seen[v] = 1;
     }
     return 0;
   }

@@ -253,7 +253,13 @@ def build_runner(
     pend, gate, tail, c = prepare_queues_sharded(cfg, workload, gates, d)
     root = prng.root_key(cfg.seed)
     state = init_sharded_state(cfg, mesh, pend, gate, tail, root)
-    round_fn = simm.build_engine(cfg, c, axis_name=INSTANCE_AXIS, n_shards=d)
+    round_fn = simm.build_engine(
+        cfg,
+        c,
+        axis_name=INSTANCE_AXIS,
+        n_shards=d,
+        vid_cap=simm.gates_vid_cap(workload, gates),
+    )
 
     def body(root, st):
         st = _unwrap(st)
