@@ -150,6 +150,33 @@ def test_in_order_client_gating():
     validate.check_in_order_clients(executed, [vids])
 
 
+def test_value_status_lifecycle():
+    """The Callback-SPI surface (ref member/paxos.h:142-163): a chosen
+    value reports accepted/applied with its instance, round, ballot,
+    and learner count; an unknown vid is pending."""
+    cfg = SimConfig(n_nodes=3, n_instances=16, proposers=(0,), seed=0)
+    vids = np.asarray([40, 41], np.int32)
+    r = sim.run(cfg, workload=[vids])
+    _check(r)
+    st = r.value_status(40)
+    assert st["status"] == "applied"  # quiescent run: all nodes learned
+    assert st["learners"] == 3 and st["ballot"] > 0 and st["round"] >= 0
+    assert r.chosen_vid[st["instance"]] == 40
+    assert r.value_status(999)["status"] == "pending"
+    # sentinels must never alias undecided/no-op instances
+    assert r.value_status(-1)["status"] == "pending"
+    assert r.value_status(-5)["status"] == "pending"
+
+
+def test_dump_helpers_format():
+    from tpu_paxos.utils import dump
+
+    assert dump.dump_hex(b"\x00\xff\x10") == "00 FF 10"
+    assert dump.dump_hex(bytes(300)).endswith("(+44 bytes)")
+    s = dump.dump_array("chosen", np.asarray([[5, -1], [7, 8]], np.int32), 3)
+    assert s == "chosen[2, 2]:int32= 5 . 7 .. (+1)"
+
+
 def test_run_state_derives_gate_cap():
     """run_state without an explicit vid_cap must still enforce gates
     (derived from the state's own gate array) — a gate-bearing state

@@ -173,6 +173,41 @@ class SimResult:
         """Per decided instance, rounds from t=0 to decision."""
         return self.chosen_round[self.chosen_vid != int(val.NONE)]
 
+    def value_status(self, vid: int) -> dict:
+        """Per-proposal completion status — the Callback SPI surface
+        (ref multi/paxos.h:241-246 ``Run``; member/paxos.h:142-163
+        ``Accepted``/``Applied``):
+
+        - ``pending``: never chosen (still queued, lost with a crashed
+          proposer, or displaced and re-proposed after this snapshot);
+        - ``accepted``: chosen — accepted by a majority of acceptors
+          (safe while a majority lives, ref member/paxos.h:149-151);
+        - ``applied``: additionally learned by a majority of nodes
+          (the Applied quorum that sequences membership changes, ref
+          member/paxos.h:155-162).
+
+        The reference's ``Unproposable`` (node is not a proposer) is a
+        config-time error here: workloads only target cfg.proposers.
+        """
+        if vid < 0:
+            # NONE / no-op sentinels are not proposals and must not
+            # alias against undecided or hole-filled instances
+            return {"status": "pending"}
+        where = np.flatnonzero(self.chosen_vid == vid)
+        if not where.size:
+            return {"status": "pending"}
+        i = int(where[0])
+        n_nodes = self.learned.shape[1]
+        learners = int((self.learned[i] != int(val.NONE)).sum())
+        applied = learners >= n_nodes // 2 + 1
+        return {
+            "status": "applied" if applied else "accepted",
+            "instance": i,
+            "round": int(self.chosen_round[i]),
+            "ballot": int(self.chosen_ballot[i]),
+            "learners": learners,
+        }
+
 
 def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
     a, i = cfg.n_nodes, cfg.n_instances

@@ -1,4 +1,6 @@
 """L0 primitives: PRNG streams (utils/prng.py), leveled host logging
-(utils/log.py — the reference Logger analog, ref multi/paxos.cpp:74-103)."""
+(utils/log.py — the reference Logger analog, ref multi/paxos.cpp:74-103),
+and TRACE dump helpers (utils/dump.py — the DumpHex analog)."""
 
+from tpu_paxos.utils.dump import dump_array, dump_hex  # noqa: F401
 from tpu_paxos.utils.log import Logger, get_logger  # noqa: F401
