@@ -271,10 +271,14 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
 
 def _select_by_argmax(values_pi, cand_pia):
     """values [P, I], cand [P, I, A] masked ballots: per (i, a) pick
-    values[argmax_p cand, i] (NONE when no candidate)."""
+    the value at the max-ballot candidate (NONE when none).
+
+    Implemented as two fused masked-max passes, NOT argmax + gather —
+    the gather lowering dominates round wall time on TPU.  Exact
+    because ballots are unique per proposer ((count << 16) | node), so
+    a ballot tie across the P axis is impossible."""
     best_b = jnp.max(cand_pia, axis=0)  # [I, A]
-    best_p = jnp.argmax(cand_pia, axis=0)  # [I, A]
-    sel = jnp.arange(cand_pia.shape[0])[:, None, None] == best_p[None]
+    sel = (cand_pia == best_b[None]) & (cand_pia != bal.NONE)
     v = jnp.max(jnp.where(sel, values_pi[:, :, None], _NEG), axis=0)
     return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
 
@@ -475,16 +479,24 @@ def build_engine(
         pecho = jnp.where(alive_a[:, None], ar.prep_echo, bal.NONE)  # [A, P]
         match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
         promises2 = pr.promises | match.T  # [P, A]
-        repb = jnp.where(
-            match.T[:, None, :], jnp.broadcast_to(snap_b[None], (p, i_loc, a)),
-            bal.NONE,
-        )  # [P, I, A]
-        best_a = jnp.argmax(repb, axis=-1)  # [P, I]
-        best_b = jnp.max(repb, axis=-1)  # [P, I]
-        best_v = jnp.take_along_axis(
-            jnp.broadcast_to(snap_v[None], (p, i_loc, a)), best_a[..., None],
+        # Adoption merge as two fused masked-max passes (argmax +
+        # take_along_axis gather cost ~1/3 of the whole round's wall
+        # time at 1M instances).  Exact: cells tied at the max ballot
+        # hold the same value — one proposer per ballot sends one
+        # value per instance, and committed-sentinel rows all hold the
+        # agreed chosen value.
+        rep_mask = match.T[:, None, :]  # [P, 1, A]
+        best_b = jnp.max(
+            jnp.where(rep_mask, snap_b[None], bal.NONE), axis=-1
+        )  # [P, I]
+        best_v = jnp.max(
+            jnp.where(
+                rep_mask & (snap_b[None] == best_b[:, :, None]),
+                snap_v[None],
+                _NEG,
+            ),
             axis=-1,
-        )[..., 0]
+        )
         take = (best_b != bal.NONE) & (best_b > pr.adopted_b)
         adopted_b = jnp.where(take, best_b, pr.adopted_b)
         adopted_v = jnp.where(take, best_v, pr.adopted_v)
@@ -553,8 +565,15 @@ def build_engine(
         # own queue onto its own lowest free instances (placement
         # differs from the unsharded engine; safety and the chosen
         # multiset do not — see parallel/sharded_sim.py).
-        hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)  # [P]
-        free = idx[None] > hi2[:, None]  # [P, I]
+        # Free instances are by construction the CONTIGUOUS suffix
+        # (hi2, end-of-shard), so free ranks are closed-form arithmetic
+        # and placement is a dynamic slice — no [P, I] cumsum and no
+        # 1M-element gather (which cost ~40% of the round's wall time).
+        hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)  # [P] global
+        hi2l = jnp.maximum(hi2, off - 1)  # clamp sentinel into this shard
+        free = idx[None] > hi2l[:, None]  # [P, I] contiguous suffix
+        free_rank = idx[None] - hi2l[:, None] - 1  # [P, I]
+        n_free = (off + i_loc - 1) - hi2l  # [P]
         if vid_cap:
             # chosen-vid membership bitmap for the gate test (only
             # True scatters; invalid indices routed out of range)
@@ -568,24 +587,30 @@ def build_engine(
             cfg.assign_window,
         )
         ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1  # [P, W]
-        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
-        k = jnp.minimum(jnp.sum(ok, axis=1), jnp.sum(free, axis=1))
+        k = jnp.minimum(jnp.sum(ok, axis=1), n_free)
         k = jnp.where(can_assign, k, 0)
         take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
-        # vid of the r-th taken entry, gatherable by free_rank: an O(W)
-        # rank scatter (taken entries have distinct ranks; untaken
-        # slots are routed out of range and dropped) — an equality
-        # one-hot here would cost O(W^2) and cap the window size
+        # vid of the r-th taken entry by rank: an O(W) rank scatter
+        # (taken entries have distinct ranks; untaken slots are routed
+        # out of range and dropped) — an equality one-hot here would
+        # cost O(W^2) and cap the window size
         w = cfg.assign_window
         prow = jnp.arange(p)[:, None]
         rank_pos = jnp.where(take_q, ok_rank, w)  # [P, W]
-        by_rank = jnp.full((p, w), _NEG, jnp.int32).at[prow, rank_pos].set(
+        by_rank = jnp.full((p, w), val.NONE, jnp.int32).at[prow, rank_pos].set(
             qvid, mode="drop"
         )  # [P, R]
         takev = free & (free_rank < k[:, None])  # instances filled
-        newv = jnp.take_along_axis(
-            by_rank, jnp.clip(free_rank, 0, w - 1), axis=1
-        )  # [P, I]
+        # place the ranked vids at the contiguous free window: a
+        # padded dynamic-slice write (start is always in [0, i_loc],
+        # so nothing clamps or shifts), truncated back to shard size
+        start = jnp.clip(hi2l + 1 - off, 0, i_loc)
+
+        def _place(br, h):
+            buf = jnp.full((i_loc + w,), val.NONE, jnp.int32)
+            return jax.lax.dynamic_update_slice(buf, br, (h,))[:i_loc]
+
+        newv = jax.vmap(_place)(by_rank, start)  # [P, I]
         cur_batch = jnp.where(takev, newv, cur_batch)
         own_assign = jnp.where(takev, newv, pr.own_assign)
         # consume taken entries in place (scatter NONE at exactly the
