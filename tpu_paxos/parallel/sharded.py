@@ -1,7 +1,8 @@
 """Instance-axis sharded protocol rounds (shard_map + collectives).
 
-The [instances, nodes] SoA state is split along instances across the
-mesh; per-acceptor scalars (promised, max_seen) are replicated.  The
+The [nodes, instances] SoA state (instances minor — see core/fast.py's
+layout note) is split along the instance axis across the mesh;
+per-acceptor scalars (promised, max_seen) are replicated.  The
 only cross-shard communication the protocol needs is:
 
 - ``pmax`` of the max-ballot-seen when a proposer picks a new ballot
@@ -28,14 +29,14 @@ from tpu_paxos.parallel.mesh import INSTANCE_AXIS
 
 
 def _state_specs() -> fast.FastState:
-    """PartitionSpec pytree for FastState: [I, A] arrays split over
-    instances, [A] scalars replicated."""
+    """PartitionSpec pytree for FastState: [A, I] arrays split over
+    the (minor) instance axis, [A] scalars replicated."""
     return fast.FastState(
         promised=P(),
         max_seen=P(),
-        acc_ballot=P(INSTANCE_AXIS),
-        acc_vid=P(INSTANCE_AXIS),
-        learned=P(INSTANCE_AXIS),
+        acc_ballot=P(None, INSTANCE_AXIS),
+        acc_vid=P(None, INSTANCE_AXIS),
+        learned=P(None, INSTANCE_AXIS),
     )
 
 
@@ -55,7 +56,7 @@ def _choose_all_local(state: fast.FastState, vids, proposer: int, quorum: int):
     state, chosen = fast.phase2_accept(state, ballot, batch, quorum)
     state = fast.phase3_learn(state, batch, chosen)
 
-    local_chosen = jnp.sum((state.learned[:, 0] != val.NONE).astype(jnp.int32))
+    local_chosen = jnp.sum((state.learned[0] != val.NONE).astype(jnp.int32))
     n_chosen = jax.lax.psum(local_chosen, INSTANCE_AXIS)
     return state, n_chosen
 
@@ -80,7 +81,7 @@ def sharded_choose_all(mesh: Mesh, proposer: int, quorum: int):
 
 
 def init_sharded_state(mesh: Mesh, n_instances: int, n_nodes: int) -> fast.FastState:
-    """FastState with [I, A] arrays laid out over the instance axis."""
+    """FastState with [A, I] arrays laid out over the (minor) instance axis."""
     if n_instances % mesh.size != 0:
         raise ValueError(
             f"n_instances ({n_instances}) must divide evenly over "
