@@ -37,10 +37,10 @@ def test_idle_prepared_proposer_repairs_hole():
         acc=st.acc._replace(
             promised=jnp_full(st.acc.promised, b),
             max_seen=jnp_full(st.acc.max_seen, b),
-            acc_ballot=st.acc.acc_ballot.at[1, :].set(b),
-            acc_vid=st.acc.acc_vid.at[1, :].set(chosen),
+            acc_ballot=st.acc.acc_ballot.at[:, 1].set(b),
+            acc_vid=st.acc.acc_vid.at[:, 1].set(chosen),
         ),
-        learned=st.learned.at[1, :].set(chosen),
+        learned=st.learned.at[:, 1].set(chosen),  # [acceptor, inst]
         prop=st.prop._replace(
             mode=st.prop.mode.at[0].set(int(sim.PREPARED)),
             count=st.prop.count.at[0].set(1),
@@ -85,7 +85,7 @@ def test_crashed_proposer_holes_repaired_by_survivor():
     st = st._replace(
         # acceptor 2 holds a stranded pre-accept from the dead proposer
         acc=st.acc._replace(
-            acc_ballot=st.acc.acc_ballot.at[2, 2].set(b1),
+            acc_ballot=st.acc.acc_ballot.at[2, 2].set(b1),  # [acc 2, inst 2]
             acc_vid=st.acc.acc_vid.at[2, 2].set(999),
         ),
         crashed=st.crashed.at[1].set(True),

@@ -89,8 +89,8 @@ def test_log_renders_noops():
     st = sim.init_state(cfg, pend, gate, tail, root)
     st = st._replace(
         acc=st.acc._replace(
-            acc_ballot=st.acc.acc_ballot.at[2, 0].set(int(bal.make(1, 2))),
-            acc_vid=st.acc.acc_vid.at[2, 0].set(999),
+            acc_ballot=st.acc.acc_ballot.at[0, 2].set(int(bal.make(1, 2))),
+            acc_vid=st.acc.acc_vid.at[0, 2].set(999),  # [acceptor, inst]
         )
     )
     r = sim.run_state(cfg, st, root, np.asarray([50, 999]), c)

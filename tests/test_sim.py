@@ -94,8 +94,8 @@ def test_adoption_and_noop_hole_fill():
     dead_ballot = int(bal.make(1, 2))
     st = st._replace(
         acc=st.acc._replace(
-            acc_ballot=st.acc.acc_ballot.at[2, 0].set(dead_ballot),
-            acc_vid=st.acc.acc_vid.at[2, 0].set(999),
+            acc_ballot=st.acc.acc_ballot.at[0, 2].set(dead_ballot),
+            acc_vid=st.acc.acc_vid.at[0, 2].set(999),  # [acceptor, inst]
         )
     )
     r = sim.run_state(cfg, st, root, np.asarray([50, 51, 999]), c)
@@ -122,8 +122,8 @@ def test_conflict_reproposal():
     rival = int(bal.make(7, 1))
     st = st._replace(
         acc=st.acc._replace(
-            acc_ballot=st.acc.acc_ballot.at[0, 1].set(rival),
-            acc_vid=st.acc.acc_vid.at[0, 1].set(777),
+            acc_ballot=st.acc.acc_ballot.at[1, 0].set(rival),
+            acc_vid=st.acc.acc_vid.at[1, 0].set(777),  # [acceptor, inst]
         ),
         prop=st.prop._replace(
             own_assign=st.prop.own_assign.at[0, 0].set(100),

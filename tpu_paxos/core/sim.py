@@ -108,8 +108,8 @@ IDLE_RESTART_ROUNDS = 8
 class AcceptorState(NamedTuple):
     promised: jax.Array  # [A] int32 scalar promised ballot per acceptor
     max_seen: jax.Array  # [A] int32 max ballot ever seen
-    acc_ballot: jax.Array  # [I, A] int32 accepted ballot (NONE none)
-    acc_vid: jax.Array  # [I, A] int32 accepted vid
+    acc_ballot: jax.Array  # [A, I] int32 accepted ballot (NONE none)
+    acc_vid: jax.Array  # [A, I] int32 accepted vid
 
 
 class ProposerState(NamedTuple):
@@ -124,7 +124,7 @@ class ProposerState(NamedTuple):
     adopted_b: jax.Array  # [P, I] int32 adopted pre-accepted ballot
     adopted_v: jax.Array  # [P, I] int32 adopted pre-accepted vid
     cur_batch: jax.Array  # [P, I] int32 vids being accepted at ballot
-    acks: jax.Array  # [P, I, A] bool per-instance accept acks
+    acks: jax.Array  # [P, A, I] bool per-instance accept acks
     acc_deadline: jax.Array  # [P] int32
     acc_retries: jax.Array  # [P] int32
     own_assign: jax.Array  # [P, I] int32 own initial proposals by instance
@@ -133,7 +133,7 @@ class ProposerState(NamedTuple):
     head: jax.Array  # [P] int32 ring head (absolute)
     tail: jax.Array  # [P] int32 ring tail (absolute)
     commit_vid: jax.Array  # [P, I] int32 values this proposer is committing
-    commit_acked: jax.Array  # [P, I, A] bool
+    commit_acked: jax.Array  # [P, A, I] bool
     commit_deadline: jax.Array  # [P] int32
     stall: jax.Array  # [P] int32 rounds spent idle while the log has holes
 
@@ -148,7 +148,7 @@ class Metrics(NamedTuple):
 class SimState(NamedTuple):
     t: jax.Array  # int32 round counter (the virtual clock)
     acc: AcceptorState
-    learned: jax.Array  # [I, A] int32 learner state per node
+    learned: jax.Array  # [A, I] int32 learner state per node (instances minor)
     prop: ProposerState
     net: netm.NetBuffers
     met: Metrics
@@ -228,10 +228,10 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
         acc=AcceptorState(
             promised=jnp.zeros((a,), jnp.int32),
             max_seen=jnp.zeros((a,), jnp.int32),
-            acc_ballot=none(i, a),
-            acc_vid=none(i, a),
+            acc_ballot=none(a, i),
+            acc_vid=none(a, i),
         ),
-        learned=none(i, a),
+        learned=none(a, i),
         prop=ProposerState(
             mode=jnp.full((p,), DELAY, jnp.int32),
             count=jnp.zeros((p,), jnp.int32),
@@ -244,7 +244,7 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
             adopted_b=none(p, i),
             adopted_v=none(p, i),
             cur_batch=none(p, i),
-            acks=jnp.zeros((p, i, a), jnp.bool_),
+            acks=jnp.zeros((p, a, i), jnp.bool_),
             acc_deadline=jnp.zeros((p,), jnp.int32),
             acc_retries=jnp.zeros((p,), jnp.int32),
             own_assign=none(p, i),
@@ -253,7 +253,7 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
             head=jnp.zeros((p,), jnp.int32),
             tail=tail,
             commit_vid=none(p, i),
-            commit_acked=jnp.zeros((p, i, a), jnp.bool_),
+            commit_acked=jnp.zeros((p, a, i), jnp.bool_),
             commit_deadline=jnp.zeros((p,), jnp.int32),
             stall=jnp.zeros((p,), jnp.int32),
         ),
@@ -269,17 +269,17 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
     )
 
 
-def _select_by_argmax(values_pi, cand_pia):
-    """values [P, I], cand [P, I, A] masked ballots: per (i, a) pick
+def _select_by_argmax(values_pi, cand_pai):
+    """values [P, I], cand [P, A, I] masked ballots: per (a, i) pick
     the value at the max-ballot candidate (NONE when none).
 
     Implemented as two fused masked-max passes, NOT argmax + gather —
     the gather lowering dominates round wall time on TPU.  Exact
     because ballots are unique per proposer ((count << 16) | node), so
     a ballot tie across the P axis is impossible."""
-    best_b = jnp.max(cand_pia, axis=0)  # [I, A]
-    sel = (cand_pia == best_b[None]) & (cand_pia != bal.NONE)
-    v = jnp.max(jnp.where(sel, values_pi[:, :, None], _NEG), axis=0)
+    best_b = jnp.max(cand_pai, axis=0)  # [A, I]
+    sel = (cand_pai == best_b[None]) & (cand_pai != bal.NONE)
+    v = jnp.max(jnp.where(sel, values_pi[:, None, :], _NEG), axis=0)
     return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
 
 
@@ -432,18 +432,18 @@ def build_engine(
         elig = has_acc & (abal[:, None] >= promised)  # >=, ref :1366
         rej_acc = has_acc & ~elig
         w_has = abat != val.NONE  # [P, I]
-        is_comm = learned != val.NONE  # [I, A]
+        is_comm = learned != val.NONE  # [A, I]
         # Per-instance ack: store-or-match (see module docstring for
         # the deviation from the reference's blanket batch ack).
         ack = (
-            elig[:, None, :]
-            & w_has[:, :, None]
+            elig[:, :, None]
+            & w_has[:, None, :]
             & jnp.where(
                 is_comm[None],
-                abat[:, :, None] == learned[None],
+                abat[:, None, :] == learned[None],
                 abal[:, None, None] >= acc.acc_ballot[None],
             )
-        )  # [P, I, A]
+        )  # [P, A, I]
         cand = jnp.where(ack & ~is_comm[None], abal[:, None, None], bal.NONE)
         store_v, store_b = _select_by_argmax(abat, cand)
         do_store = store_b != bal.NONE
@@ -456,9 +456,9 @@ def build_engine(
         # send-time batch — a legal later send).
         cpres = ar.com_pres & alive_a[None, :]  # [P, A]
         cbat = st.prop.commit_vid  # [P, I]
-        inc = cpres[:, None, :] & (cbat != val.NONE)[:, :, None]  # [P, I, A]
-        has_inc = jnp.any(inc, axis=0)  # [I, A]
-        inc_v = jnp.max(jnp.where(inc, cbat[:, :, None], _NEG), axis=0)
+        inc = cpres[:, :, None] & (cbat != val.NONE)[:, None, :]  # [P, A, I]
+        has_inc = jnp.any(inc, axis=0)  # [A, I]
+        inc_v = jnp.max(jnp.where(inc, cbat[:, None, :], _NEG), axis=0)
         learned = jnp.where(has_inc & (learned == val.NONE), inc_v, learned)
 
         acc = AcceptorState(promised, max_seen, acc_ballot, acc_vid)
@@ -485,17 +485,17 @@ def build_engine(
         # hold the same value — one proposer per ballot sends one
         # value per instance, and committed-sentinel rows all hold the
         # agreed chosen value.
-        rep_mask = match.T[:, None, :]  # [P, 1, A]
+        rep_mask = match.T[:, :, None]  # [P, A, 1]
         best_b = jnp.max(
-            jnp.where(rep_mask, snap_b[None], bal.NONE), axis=-1
+            jnp.where(rep_mask, snap_b[None], bal.NONE), axis=1
         )  # [P, I]
         best_v = jnp.max(
             jnp.where(
-                rep_mask & (snap_b[None] == best_b[:, :, None]),
+                rep_mask & (snap_b[None] == best_b[:, None, :]),
                 snap_v[None],
                 _NEG,
             ),
-            axis=-1,
+            axis=1,
         )
         take = (best_b != bal.NONE) & (best_b > pr.adopted_b)
         adopted_b = jnp.where(take, best_b, pr.adopted_b)
@@ -508,7 +508,7 @@ def build_engine(
         now_prepared = (
             (pr.mode == PREPARING) & (n_prom >= quorum) & prop_alive
         )
-        committed_p = (learned[:, :] != val.NONE)[:, pn].T  # [P, I]
+        committed_p = learned[pn] != val.NONE  # [P, I]
         use_adopt = ~committed_p & (adopted_b != bal.NONE)
         covered0 = committed_p | use_adopt
         # Hole-fill frontier: local while this shard still has values
@@ -636,18 +636,18 @@ def build_engine(
         # higher-ballot overwrites in between are reply drops — legal.
         aecho = jnp.where(alive_a[:, None], ar.acc_echo, bal.NONE)  # [A, P]
         amatch = (aecho == pr.ballot[None, :]) & (mode[None, :] == PREPARED)
-        hold = (acc.acc_vid[None] == cur_batch[:, :, None]) & (
+        hold = (acc.acc_vid[None] == cur_batch[:, None, :]) & (
             acc.acc_ballot[None] == pr.ballot[:, None, None]
-        )  # [P, I, A]
-        comm = (learned[None] == cur_batch[:, :, None]) & (
+        )  # [P, A, I]
+        comm = (learned[None] == cur_batch[:, None, :]) & (
             learned[None] != val.NONE
         )
         acks = acks | (
-            amatch.T[:, None, :]
-            & (cur_batch != val.NONE)[:, :, None]
+            amatch.T[:, :, None]
+            & (cur_batch != val.NONE)[:, None, :]
             & (hold | comm)
         )
-        n_ack = jnp.sum(acks, axis=-1)  # [P, I]
+        n_ack = jnp.sum(acks, axis=1)  # [P, I]
         inst_chosen = (cur_batch != val.NONE) & (n_ack >= quorum)
         newly = inst_chosen & (pr.commit_vid == val.NONE) & prop_alive[:, None]
         commit_vid = jnp.where(newly, cur_batch, pr.commit_vid)
@@ -671,12 +671,12 @@ def build_engine(
         # learned cell equals the committed vid).
         crep = ar.com_rep & alive_a[:, None]  # [A, P]
         commit_acked = pr.commit_acked | (
-            crep.T[:, None, :]
-            & (commit_vid != val.NONE)[:, :, None]
-            & (learned[None] == commit_vid[:, :, None])
+            crep.T[:, :, None]
+            & (commit_vid != val.NONE)[:, None, :]
+            & (learned[None] == commit_vid[:, None, :])
         )
         not_all_acked = (commit_vid != val.NONE) & ~jnp.all(
-            commit_acked | st.crashed[None, None, :], axis=-1
+            commit_acked | st.crashed[None, :, None], axis=1
         )
         resend_c = (t >= pr.commit_deadline)[:, None] & not_all_acked
         send_commit_i = (newly | resend_c) & prop_alive[:, None]  # [P, I]
@@ -687,7 +687,7 @@ def build_engine(
 
         # Conflict re-proposal + own-value completion
         # (ref OnCommit, multi/paxos.cpp:1540-1569).
-        learned_p = learned[:, :][:, pn].T  # [P, I] post-commit view
+        learned_p = learned[pn]  # [P, I] post-commit view
         own_has2 = own_assign != val.NONE
         conflict = own_has2 & (learned_p != val.NONE) & (learned_p != own_assign)
         own_done = own_has2 & (learned_p == own_assign)
@@ -875,7 +875,7 @@ def build_engine(
             (met.chosen_vid != val.NONE) | (idx > hmax)
         ))
         learned_ok = gall(jnp.all(
-            (learned != val.NONE) | crashed[None, :] | (idx[:, None] > hmax)
+            (learned != val.NONE) | crashed[:, None] | (idx[None, :] > hmax)
         ))
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
 
@@ -1040,7 +1040,7 @@ def run_state(
 
     final = _go(root, state)
     return SimResult(
-        learned=np.asarray(final.learned),
+        learned=np.asarray(final.learned).T,  # host convention [I, A]
         chosen_vid=np.asarray(final.met.chosen_vid),
         chosen_round=np.asarray(final.met.chosen_round),
         chosen_ballot=np.asarray(final.met.chosen_ballot),

@@ -10,8 +10,11 @@ communication), so the only cross-chip traffic is tiny ``pmax``/
 slice and DCN across slices.
 
 Mesh axes:
-- ``i``: instance-axis shards (ICI). All [instances, ...] arrays are
-  split along it.
+- ``i``: instance-axis shards (ICI).  Protocol arrays keep instances
+  MINOR ([A, I] / [P, I] / [P, A, I] — see core/fast.py's layout
+  note) and are split along that minor instance axis
+  (``P(None, 'i')`` / ``P(None, None, 'i')``); plain [I] vectors
+  split on dim 0 (``shard_instances``).
 - per-acceptor scalars ([nodes]-shaped) are replicated.
 
 Multi-host: ``jax.distributed.initialize()`` + the same mesh spanning

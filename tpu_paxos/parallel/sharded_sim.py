@@ -11,8 +11,9 @@ contiguous block of instances and the cross-shard traffic is a handful
 of scalar/[P]-sized ``pmax``/``psum`` reductions per round over ICI.
 
 Sharding layout:
-- ``[I, A]`` / ``[P, I]`` / ``[P, I, A]`` protocol arrays: split over
-  the instance axis.
+- ``[A, I]`` / ``[P, I]`` / ``[P, A, I]`` protocol arrays (instances
+  minor — see core/sim.py's layout note): split over the instance
+  axis.
 - ``[P]`` / ``[A]`` scalars and the network calendars: replicated —
   their updates are functions of replicated arrivals plus the global
   reductions, so every shard computes identical copies.
@@ -52,9 +53,12 @@ def _state_specs() -> simm.SimState:
     return simm.SimState(
         t=P(),
         acc=simm.AcceptorState(
-            promised=P(), max_seen=P(), acc_ballot=_I, acc_vid=_I
+            promised=P(),
+            max_seen=P(),
+            acc_ballot=P(None, INSTANCE_AXIS),
+            acc_vid=P(None, INSTANCE_AXIS),
         ),
-        learned=_I,
+        learned=P(None, INSTANCE_AXIS),
         prop=simm.ProposerState(
             mode=P(),
             count=P(),
@@ -67,7 +71,7 @@ def _state_specs() -> simm.SimState:
             adopted_b=P(None, INSTANCE_AXIS),
             adopted_v=P(None, INSTANCE_AXIS),
             cur_batch=P(None, INSTANCE_AXIS),
-            acks=P(None, INSTANCE_AXIS, None),
+            acks=P(None, None, INSTANCE_AXIS),
             acc_deadline=P(),
             acc_retries=P(),
             own_assign=P(None, INSTANCE_AXIS),
@@ -77,7 +81,7 @@ def _state_specs() -> simm.SimState:
             head=P(INSTANCE_AXIS, None),
             tail=P(INSTANCE_AXIS, None),
             commit_vid=P(None, INSTANCE_AXIS),
-            commit_acked=P(None, INSTANCE_AXIS, None),
+            commit_acked=P(None, None, INSTANCE_AXIS),
             commit_deadline=P(),
             stall=P(),
         ),
@@ -292,7 +296,7 @@ def build_runner(
 
 def to_result(final: simm.SimState, expected: np.ndarray) -> simm.SimResult:
     return simm.SimResult(
-        learned=np.asarray(final.learned),
+        learned=np.asarray(final.learned).T,  # host convention [I, A]
         chosen_vid=np.asarray(final.met.chosen_vid),
         chosen_round=np.asarray(final.met.chosen_round),
         chosen_ballot=np.asarray(final.met.chosen_ballot),
