@@ -7,9 +7,10 @@ accept + commit windows over fresh instances (the reference's
 long-running proposer does exactly this: one prepare, then batched
 accepts for every subsequent proposal, ref multi/paxos.cpp:1256-1275).
 The window size is a throughput knob: per-window dispatch overhead
-(~3-8 ms) amortizes over the window, so the default drives 32M
-instances per window — the [A, I] minor-instance layout keeps every
-op lane-dense at any size.
+(~3-8 ms) amortizes over the window, so the default drives 128M
+instances per window on TPU (~8 GiB of FastState, donated in place;
+CPU fallback defaults smaller) — the [A, I] minor-instance layout
+keeps every op lane-dense at any size.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "instances/sec", "vs_baseline": N}
@@ -19,8 +20,8 @@ instances/sec (BASELINE.json) — the reference itself publishes no
 numbers (BASELINE.md), so >1.0 means the north star is beaten.
 
 Environment knobs: TPU_PAXOS_BENCH_INSTANCES (window size, default
-2^25), TPU_PAXOS_BENCH_NODES (default 5), TPU_PAXOS_BENCH_REPS (windows
-per timed call, default 4), TPU_PAXOS_BENCH_SHARDED=1 (use every
+2^27), TPU_PAXOS_BENCH_NODES (default 5), TPU_PAXOS_BENCH_REPS (windows
+per timed call, default 2), TPU_PAXOS_BENCH_SHARDED=1 (use every
 visible device via shard_map — BASELINE config 4 shape).
 """
 
@@ -303,9 +304,15 @@ def _sharded_records_via_subprocess(n_devices: int = 8) -> list[dict]:
 
 
 def main() -> None:
-    n_inst = int(os.environ.get("TPU_PAXOS_BENCH_INSTANCES", 1 << 25))
+    # default window is platform-scaled: 128M instances (~8 GiB of
+    # FastState) suits the 16 GB v5e; the CPU fallback (no TPU) gets a
+    # size that completes on an ordinary host
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_inst = int(
+        os.environ.get("TPU_PAXOS_BENCH_INSTANCES", 1 << 27 if on_tpu else 1 << 22)
+    )
     n_nodes = int(os.environ.get("TPU_PAXOS_BENCH_NODES", 5))
-    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 4))
+    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 2 if on_tpu else 4))
     use_sharded = os.environ.get("TPU_PAXOS_BENCH_SHARDED", "0") == "1"
     quorum = n_nodes // 2 + 1
 
