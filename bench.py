@@ -10,7 +10,10 @@ The window size is a throughput knob: per-window dispatch overhead
 (~3-8 ms) amortizes over the window, so the default drives 128M
 instances per window on TPU (~8 GiB of FastState, donated in place;
 CPU fallback defaults smaller) — the [A, I] minor-instance layout
-keeps every op lane-dense at any size.
+keeps every op lane-dense at any size.  On TPU the window loop runs as
+one pallas launch (``core/fastwin.py``): a single fused HBM pass per
+window instead of XLA's ~5 passes, with 16 windows per call — exactly
+filling the int32 vid space at 2^27 instances/window.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "instances/sec", "vs_baseline": N}
@@ -21,8 +24,10 @@ numbers (BASELINE.md), so >1.0 means the north star is beaten.
 
 Environment knobs: TPU_PAXOS_BENCH_INSTANCES (window size, default
 2^27), TPU_PAXOS_BENCH_NODES (default 5), TPU_PAXOS_BENCH_REPS (windows
-per timed call, default 2), TPU_PAXOS_BENCH_SHARDED=1 (use every
-visible device via shard_map — BASELINE config 4 shape).
+per timed call, default 16 on TPU / 4 on CPU), TPU_PAXOS_BENCH_FUSED=0
+(force the XLA scan instead of the pallas kernel),
+TPU_PAXOS_BENCH_SHARDED=1 (use every visible device via shard_map —
+BASELINE config 4 shape).
 """
 
 from __future__ import annotations
@@ -45,18 +50,33 @@ from tpu_paxos.core import values as val
 NORTH_STAR = 10_000_000.0  # instances/sec, BASELINE.json north_star
 
 
+def _total(counts) -> int:
+    """Host-side sum of per-window chosen counts (both window paths
+    return [reps] int32 — reps x I can exceed int32)."""
+    import numpy as np
+
+    return int(np.asarray(counts, dtype=np.int64).sum())
+
+
 def _steady_state_windows(
     state: fast.FastState, vids0, reps: int, quorum: int, span: int | None = None
 ):
     """Phase-1 once, then `reps` accept+learn windows over fresh
-    instance windows (state arrays recycled as the sliding window)."""
+    instance windows (state arrays recycled as the sliding window).
+    Returns (state, per-window chosen counts [reps]) — counts stay
+    per-window because a running int32 total wraps at 2^31 instances
+    (reps=16 x 2^27 hits it exactly); callers sum in host integers."""
+    if reps * (span or vids0.shape[0]) > 1 << 31:
+        raise ValueError(
+            f"reps * span = {reps * (span or vids0.shape[0])} exceeds the "
+            "int32 vid space (vid 2^31 would wrap to the NONE sentinel)"
+        )
     _, ballot = bal.bump_past(
         jnp.int32(0), jnp.int32(0), jnp.max(state.max_seen)
     )
     state, prepared, _, _ = fast.phase1_prepare(state, ballot, quorum)
 
-    def window(carry, k):
-        st, total = carry
+    def window(st, k):
         # A fresh window of instances: clear per-instance state, new vids.
         st = st._replace(
             acc_ballot=jnp.full_like(st.acc_ballot, bal.NONE),
@@ -71,12 +91,12 @@ def _steady_state_windows(
         st, chosen = fast.phase2_accept(st, ballot, vids, quorum)
         st = fast.phase3_learn(st, vids, chosen)
         n = jnp.sum((st.learned[0] != val.NONE).astype(jnp.int32))
-        return (st, total + n), None
+        return st, n
 
-    (state, total), _ = jax.lax.scan(
-        window, (state, jnp.int32(0)), jnp.arange(reps, dtype=jnp.int32)
+    state, counts = jax.lax.scan(
+        window, state, jnp.arange(reps, dtype=jnp.int32)
     )
-    return state, total
+    return state, counts
 
 
 def _sharded_fast_setup(n_nodes: int, n_inst: int, reps: int, donate: bool):
@@ -94,16 +114,16 @@ def _sharded_fast_setup(n_nodes: int, n_inst: int, reps: int, donate: bool):
     state = psharded.init_sharded_state(mesh, n_inst, n_nodes)
 
     def _local(st, v):
-        st, local_total = _steady_state_windows(
+        st, local_counts = _steady_state_windows(
             st, v, reps=reps, quorum=quorum, span=n_inst
         )
-        return st, jax.lax.psum(local_total, pmesh.INSTANCE_AXIS)
+        return st, jax.lax.psum(local_counts, pmesh.INSTANCE_AXIS)
 
     body = jax.shard_map(
         _local,
         mesh=mesh,
         in_specs=(psharded._state_specs(), P(pmesh.INSTANCE_AXIS)),
-        out_specs=(psharded._state_specs(), P()),
+        out_specs=(psharded._state_specs(), P(None)),
         check_vma=False,
     )
     step = jax.jit(body, donate_argnums=(0,) if donate else ())
@@ -221,7 +241,7 @@ def bench_sharded_child() -> list[dict]:
     _, total = step(state2, vids0)
     total.block_until_ready()
     dt = time.perf_counter() - t0
-    assert int(total) == n_inst * reps
+    assert _total(total) == n_inst * reps
     records.append(
         {
             "engine": "fast",
@@ -312,26 +332,64 @@ def main() -> None:
         os.environ.get("TPU_PAXOS_BENCH_INSTANCES", 1 << 27 if on_tpu else 1 << 22)
     )
     n_nodes = int(os.environ.get("TPU_PAXOS_BENCH_NODES", 5))
-    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 2 if on_tpu else 4))
+    # 16 windows x 2^27 instances fills the int32 vid space exactly and
+    # amortizes the per-dispatch overhead (~90 ms through the device
+    # tunnel) over ~400 ms of device work.
+    reps = int(os.environ.get("TPU_PAXOS_BENCH_REPS", 16 if on_tpu else 4))
     use_sharded = os.environ.get("TPU_PAXOS_BENCH_SHARDED", "0") == "1"
     quorum = n_nodes // 2 + 1
 
-    if use_sharded and len(jax.devices()) > 1:
-        _, step, state, vids0, n_inst = _sharded_fast_setup(
-            n_nodes, n_inst, reps, donate=True
+    def _fresh():
+        return fast.init_state(n_inst, n_nodes), jnp.arange(
+            n_inst, dtype=jnp.int32
         )
-    else:
-        vids0 = jnp.arange(n_inst, dtype=jnp.int32)
-        state = fast.init_state(n_inst, n_nodes)
+
+    def _scan_setup():
+        state, vids0 = _fresh()
         step = jax.jit(
             functools.partial(_steady_state_windows, reps=reps, quorum=quorum),
             donate_argnums=(0,),
         )
+        return state, vids0, step
 
-    # Warmup / compile.
-    state2, total = step(state, vids0)
-    total.block_until_ready()
-    assert int(total) == n_inst * reps, f"warmup chose {int(total)}"
+    fused = (
+        on_tpu
+        and not use_sharded
+        and os.environ.get("TPU_PAXOS_BENCH_FUSED", "1") == "1"
+    )
+    if use_sharded and len(jax.devices()) > 1:
+        _, step, state, vids0, n_inst = _sharded_fast_setup(
+            n_nodes, n_inst, reps, donate=True
+        )
+    elif fused:
+        from tpu_paxos.core import fastwin
+
+        state, vids0 = _fresh()
+        step = functools.partial(
+            fastwin.steady_state_windows_fused, reps=reps, quorum=quorum
+        )
+    else:
+        state, vids0, step = _scan_setup()
+
+    # Warmup / compile.  If the pallas path fails on this backend, fall
+    # back to the XLA scan rather than losing the bench run (both paths
+    # share the vid-space guard, so a config error re-raises there).
+    try:
+        state2, total = step(state, vids0)
+        total.block_until_ready()
+    except Exception as e:
+        if not fused:
+            raise
+        print(
+            f"pallas fused window failed ({e!r}); falling back to XLA scan",
+            file=sys.stderr,
+        )
+        fused = False
+        del state
+        state, vids0, step = _scan_setup()
+        state2, total = step(state, vids0)
+        total.block_until_ready()
+    assert _total(total) == n_inst * reps, f"warmup chose {_total(total)}"
 
     # Optional profiler capture of the timed window
     # (TPU_PAXOS_BENCH_PROFILE=<dir>; view with tensorboard/xprof).
@@ -349,7 +407,7 @@ def main() -> None:
         total.block_until_ready()
         dt = time.perf_counter() - t0
 
-    n_chosen = int(total)
+    n_chosen = _total(total)
     assert n_chosen == n_inst * reps, f"bench chose {n_chosen}"
     rate = n_chosen / dt
 
@@ -385,6 +443,7 @@ def main() -> None:
                     "n_instances_per_window": n_inst,
                     "windows": reps,
                     "sharded": bool(use_sharded and len(jax.devices()) > 1),
+                    "fused_kernel": fused,
                     "devices": len(jax.devices()),
                     "platform": jax.devices()[0].platform,
                 },

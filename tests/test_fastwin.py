@@ -1,0 +1,117 @@
+"""The pallas-fused steady-state window must be bit-identical to the
+XLA scan path it replaces (bench._steady_state_windows) — run here on
+the CPU pallas interpreter; the real kernel runs on TPU in bench.py."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+from tpu_paxos.core import fast, fastwin
+
+
+def _both(state_args, reps, quorum, span=None):
+    i, n = state_args
+    vids0 = jnp.arange(i, dtype=jnp.int32)
+    ref_step = jax.jit(
+        functools.partial(
+            bench._steady_state_windows, reps=reps, quorum=quorum, span=span
+        )
+    )
+    st_ref, cnt_ref = ref_step(fast.init_state(i, n), vids0)
+    st_new, cnt = fastwin.steady_state_windows_fused(
+        fast.init_state(i, n),
+        vids0,
+        reps=reps,
+        quorum=quorum,
+        span=span,
+        interpret=True,
+    )
+    assert cnt_ref.shape == cnt.shape == (reps,)
+    return st_ref, bench._total(cnt_ref), st_new, cnt
+
+
+@pytest.mark.parametrize("reps", [1, 3])
+def test_fused_matches_scan_bit_identical(reps):
+    st_ref, tot_ref, st_new, cnt = _both((fastwin.TILE * 2, 5), reps, 3)
+    assert bench._total(cnt) == tot_ref
+    for name in ("promised", "max_seen", "acc_ballot", "acc_vid", "learned"):
+        a = np.asarray(getattr(st_ref, name))
+        b = np.asarray(getattr(st_new, name))
+        assert (a == b).all(), f"{name} diverges from the scan path"
+
+
+def test_fused_no_quorum_chooses_nothing():
+    # 3 of 5 acceptors already promised a higher ballot: phase 1 cannot
+    # reach quorum, so no window stores or learns anything.
+    i, n = fastwin.TILE, 5
+    st0 = fast.init_state(i, n)
+    # promised high (count=10 in the ballot's high bits), max_seen low:
+    # these acceptors promised a ballot this proposer has never seen,
+    # so its bump_past(max_seen=0) ballot of count 1 stays below it
+    high = 10 << 16
+    st0 = st0._replace(
+        promised=jnp.array([high, high, high, 0, 0], jnp.int32),
+    )
+    ref_step = jax.jit(
+        functools.partial(bench._steady_state_windows, reps=2, quorum=3)
+    )
+    vids0 = jnp.arange(i, dtype=jnp.int32)
+    st_ref, cnt_ref = ref_step(st0, vids0)
+    tot_ref = bench._total(cnt_ref)
+    st_new, cnt = fastwin.steady_state_windows_fused(
+        fast.init_state(i, n)._replace(
+            promised=st0.promised, max_seen=st0.max_seen
+        ),
+        vids0,
+        reps=2,
+        quorum=3,
+        interpret=True,
+    )
+    assert tot_ref == 0 and bench._total(cnt) == 0
+    assert (np.asarray(st_new.learned) == -1).all()
+    for name in ("acc_ballot", "acc_vid", "learned"):
+        assert (
+            np.asarray(getattr(st_ref, name))
+            == np.asarray(getattr(st_new, name))
+        ).all()
+
+
+def test_fused_sharded_span_semantics():
+    # span > I (the sharded per-device slice case): window k's vids
+    # offset by the global span, identical to the scan path.
+    st_ref, tot_ref, st_new, cnt = _both(
+        (fastwin.TILE, 3), 2, 2, span=fastwin.TILE * 8
+    )
+    assert bench._total(cnt) == tot_ref
+    assert (
+        np.asarray(st_ref.acc_vid) == np.asarray(st_new.acc_vid)
+    ).all()
+
+
+def test_fused_rejects_vid_space_overflow():
+    st = fast.init_state(fastwin.TILE, 3)
+    vids0 = jnp.arange(fastwin.TILE, dtype=jnp.int32)
+    with pytest.raises(ValueError, match="vid space"):
+        fastwin.steady_state_windows_fused(
+            st,
+            vids0,
+            reps=(1 << 31) // fastwin.TILE + 1,
+            quorum=2,
+            interpret=True,
+        )
+
+
+def test_fused_rejects_ragged_instances():
+    st = fast.init_state(fastwin.TILE + 128, 3)
+    with pytest.raises(ValueError, match="multiple"):
+        fastwin.steady_state_windows_fused(
+            st,
+            jnp.arange(fastwin.TILE + 128, dtype=jnp.int32),
+            reps=1,
+            quorum=2,
+            interpret=True,
+        )

@@ -1,0 +1,185 @@
+"""Pallas-fused steady-state accept/learn window for the fast path.
+
+The headline bench drives windows of I fresh instances through one
+prepared proposer's batched accept + commit (``bench._steady_state_windows``,
+mirroring the reference's long-running proposer: one prepare, then
+batched accepts forever, ref multi/paxos.cpp:1256-1326, commit
+1446-1479).  Under XLA that loop lowers to ~5 separate HBM passes per
+window (recycle-fill of each state array, the accept stores, the learn
+store, the vid materialization) — measured ~30 ms per 128M-instance
+window on a v5e chip, ~3.5x the single-pass roofline.
+
+This module fuses one FULL window into a single pallas pass: for each
+[A, TILE] tile it computes the fresh-window vids, the per-acceptor
+store mask, and writes ``acc_ballot``/``acc_vid``/``learned`` exactly
+once, accumulating the per-window chosen count in SMEM.  The ``reps``
+window loop is the outer grid dimension, so one kernel launch runs the
+whole steady-state scan with zero intermediate materialization.
+
+Semantics are bit-identical to the XLA scan path (asserted by
+``tests/test_fastwin.py`` on the CPU interpreter): per window k
+  vid[i]            = prepared ? vids0[i] + k*span : NONE
+  store[a, i]       = ok[a] & (vid[i] != NONE)      (ok = ballot >= promised,
+                                                     ref multi/paxos.cpp:1366)
+  acc_ballot[a, i]  = store ? ballot : NONE          (recycle-fill + accept)
+  acc_vid[a, i]     = store ? vid[i] : NONE
+  learned[a, i]     = chosen & vid!=NONE ? vid : NONE  (commit broadcast)
+  count            += sum(learned[0] != NONE)
+where ``prepared``/``chosen`` are the phase-1/phase-2 quorum bools —
+scalars, computed outside the kernel (they are [A]-reductions).
+
+Only the single-device TPU path uses this kernel; the sharded and CPU
+paths keep the XLA scan (`bench._steady_state_windows`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import fast
+from tpu_paxos.core import values as val
+
+_B_NONE = int(bal.NONE)  # -1
+_V_NONE = int(val.NONE)  # -1
+
+# Instances per tile: (5, 65536) int32 = 1.25 MB per ref, 3.75 MB over
+# the three outputs (+0.25 MB vids in), ~8 MB double-buffered — inside
+# the ~16 MB VMEM budget at A=5; revisit before raising TILE or A.
+TILE = 65536
+
+
+def _window_kernel(
+    scals_ref, ok_ref, vids_ref, ab_in, av_in, lr_in, ab_ref, av_ref, lr_ref, cnt_ref
+):
+    # ab_in/av_in/lr_in are the previous window's buffers, aliased to
+    # the outputs so the 8 GiB state is recycled in place; the kernel
+    # never reads them (every cell is overwritten).
+    del ab_in, av_in, lr_in
+    k = pl.program_id(0)  # window (rep) index
+    t = pl.program_id(1)  # instance tile index
+    ballot = scals_ref[0]
+    span = scals_ref[1]
+    prepared = scals_ref[2] != 0
+    chosen = scals_ref[3] != 0
+
+    # Fresh-window vids for this tile: [1, T].
+    v = vids_ref[:, :] + k * span
+    v = jnp.where(prepared, v, _V_NONE)
+    has = v != _V_NONE  # [1, T]
+
+    ok = ok_ref[:, :] != 0  # [A, 1] per-acceptor accept mask (VMEM)
+    store = ok & has  # [A, T]
+    ab_ref[:, :] = jnp.where(store, ballot, _B_NONE)
+    av_ref[:, :] = jnp.where(store, v, _V_NONE)
+
+    learn = chosen & has  # [1, T] commit broadcast mask
+    lr_ref[:, :] = jnp.broadcast_to(
+        jnp.where(learn, v, _V_NONE), lr_ref.shape
+    )
+
+    @pl.when(t == 0)
+    def _init():
+        cnt_ref[k, 0] = 0
+
+    # Per-window chosen count, taken from node 0's learner row as in
+    # the scan path (rows are identical under the broadcast commit).
+    # One int32 slot per window — a single running total would wrap at
+    # 2^31 instances (reps x I overflows int32 from reps=16 at I=2^27);
+    # callers sum the per-window counts in host integers.
+    cnt_ref[k, 0] += jnp.sum(learn.astype(jnp.int32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("reps", "quorum", "span", "interpret"),
+    donate_argnums=(0,),
+)
+def steady_state_windows_fused(
+    state: fast.FastState,
+    vids0: jax.Array,
+    reps: int,
+    quorum: int,
+    span: int | None = None,
+    interpret: bool = False,
+):
+    """Pallas twin of ``bench._steady_state_windows`` running all
+    ``reps`` windows in one launch (single HBM pass per array per
+    window).  Returns ``(state, per_window_counts [reps])`` — counts
+    stay per-window so host summation can exceed int32."""
+    a, i = state.acc_ballot.shape
+    if i % TILE:
+        raise ValueError(f"n_instances ({i}) must be a multiple of {TILE}")
+    # Window k proposes vids0 + k*span: the top of the int32 vid space
+    # is the hard capacity bound — one id per instance ever chosen
+    # (vid 2^31 would wrap to the NONE sentinel).
+    if reps * (span or i) > 1 << 31:
+        raise ValueError(
+            f"reps * span = {reps * (span or i)} exceeds the int32 vid space"
+        )
+
+    # Phase 1 once — identical to the scan path.
+    _, ballot = bal.bump_past(
+        jnp.int32(0), jnp.int32(0), jnp.max(state.max_seen)
+    )
+    state, prepared, _, _ = fast.phase1_prepare(state, ballot, quorum)
+
+    # The scalar protocol decisions for every window (the state they
+    # depend on does not change while only accepts flow; phase 1 has
+    # already folded this ballot into max_seen).
+    ok = ballot >= state.promised  # [A], ref multi/paxos.cpp:1366
+    chosen = jnp.sum(ok.astype(jnp.int32)) >= quorum
+
+    scals = jnp.stack(
+        [
+            ballot,
+            jnp.int32(span or i),
+            prepared.astype(jnp.int32),
+            chosen.astype(jnp.int32),
+        ]
+    )
+    ok_col = ok.astype(jnp.int32)[:, None]  # [A, 1]
+
+    grid = (reps, i // TILE)
+    out_shape = [
+        jax.ShapeDtypeStruct((a, i), jnp.int32),  # acc_ballot
+        jax.ShapeDtypeStruct((a, i), jnp.int32),  # acc_vid
+        jax.ShapeDtypeStruct((a, i), jnp.int32),  # learned
+        jax.ShapeDtypeStruct((reps, 1), jnp.int32),  # per-window counts
+    ]
+    tile_spec = pl.BlockSpec((a, TILE), lambda k, t, s: (0, t))
+    ab, av, lr, cnt = pl.pallas_call(
+        _window_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((a, 1), lambda k, t, s: (0, 0)),
+                pl.BlockSpec((1, TILE), lambda k, t, s: (0, t)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                tile_spec,
+                tile_spec,
+                tile_spec,
+                pl.BlockSpec(
+                    (reps, 1),
+                    lambda k, t, s: (0, 0),
+                    memory_space=pltpu.SMEM,
+                ),
+            ],
+        ),
+        out_shape=out_shape,
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(scals, ok_col, vids0[None, :], state.acc_ballot, state.acc_vid, state.learned)
+
+    state = state._replace(acc_ballot=ab, acc_vid=av, learned=lr)
+    return state, cnt[:, 0]
