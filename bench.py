@@ -108,7 +108,10 @@ def _sharded_fast_setup(n_nodes: int, n_inst: int, reps: int, donate: bool):
     from tpu_paxos.parallel import sharded as psharded
 
     quorum = n_nodes // 2 + 1
-    mesh = pmesh.make_instance_mesh()
+    mesh = pmesh.make_instance_mesh(
+        dcn_hosts=int(os.environ.get("TPU_PAXOS_BENCH_DCN_HOSTS", "1"))
+    )
+    axes = pmesh.instance_axes(mesh)
     n_inst -= n_inst % mesh.size
     vids0 = pmesh.shard_instances(mesh, jnp.arange(n_inst, dtype=jnp.int32))
     state = psharded.init_sharded_state(mesh, n_inst, n_nodes)
@@ -117,13 +120,13 @@ def _sharded_fast_setup(n_nodes: int, n_inst: int, reps: int, donate: bool):
         st, local_counts = _steady_state_windows(
             st, v, reps=reps, quorum=quorum, span=n_inst
         )
-        return st, jax.lax.psum(local_counts, pmesh.INSTANCE_AXIS)
+        return st, jax.lax.psum(local_counts, axes)
 
     body = jax.shard_map(
         _local,
         mesh=mesh,
-        in_specs=(psharded._state_specs(), P(pmesh.INSTANCE_AXIS)),
-        out_specs=(psharded._state_specs(), P(None)),
+        in_specs=(psharded._state_specs(axes), P(axes)),
+        out_specs=(psharded._state_specs(axes), P(None)),
         check_vma=False,
     )
     step = jax.jit(body, donate_argnums=(0,) if donate else ())

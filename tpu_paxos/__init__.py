@@ -32,7 +32,6 @@ from tpu_paxos.config import (
     ProtocolConfig,
     SimConfig,
 )
-from tpu_paxos.core import ballot, values
 
 __version__ = "0.2.0"
 
@@ -44,3 +43,16 @@ __all__ = [
     "values",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # Lazy re-exports (PEP 562): importing the package must not touch
+    # jax — ``core.ballot``/``core.values`` build device constants at
+    # import, which would initialize the backend before the CLI
+    # (``python -m tpu_paxos`` imports this module first) can select
+    # ``--backend``/``--mesh`` device provisioning.
+    if name in ("ballot", "values"):
+        import importlib
+
+        return importlib.import_module(f"tpu_paxos.core.{name}")
+    raise AttributeError(f"module 'tpu_paxos' has no attribute {name!r}")

@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=0,
                    help="shard the instance axis over this many devices "
                    "(0 = unsharded)")
+    p.add_argument("--dcn-hosts", type=int, default=1,
+                   help="with --mesh, arrange devices as a 2-D "
+                   "(dcn-hosts x chips) multi-host mesh; collectives "
+                   "reduce over both axes")
     p.add_argument("--engine", choices=("sim", "fast", "member"),
                    default="sim")
     p.add_argument("--json", action="store_true",
@@ -76,7 +80,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _select_backend(backend: str) -> None:
+def _select_backend(backend: str, mesh: int = 0) -> None:
     if backend == "auto":
         return
     os.environ["JAX_PLATFORMS"] = backend
@@ -84,6 +88,10 @@ def _select_backend(backend: str) -> None:
 
     try:
         jax.config.update("jax_platforms", backend)
+        if backend == "cpu" and mesh > 1:
+            # provision enough virtual CPU devices for the requested
+            # mesh (a dev box has one CPU device by default)
+            jax.config.update("jax_num_cpu_devices", mesh)
     except RuntimeError:
         pass  # backend already initialized; env var did its best
 
@@ -137,10 +145,16 @@ def run_sim(args) -> int:
 
         # build the mesh first: it may have fewer devices than
         # requested, and the padding must match its actual size
-        mesh = pmesh.make_instance_mesh(args.mesh)
-        pad = (-cfg.n_instances) % mesh.size
-        if pad:
-            cfg = dataclasses.replace(cfg, n_instances=cfg.n_instances + pad)
+        mesh = pmesh.make_instance_mesh(args.mesh, dcn_hosts=args.dcn_hosts)
+        # The chain-aware split keeps each client's gate chain on one
+        # shard, so per-shard demand is set by the largest chain
+        # cluster, not n_instances/D (e.g. 8 shards, 2 chains: two
+        # shards carry everything and the rest sit idle).
+        need = sharded_sim.min_instances(workload, gates, mesh.size)
+        n_inst = max(cfg.n_instances, need)
+        n_inst += (-n_inst) % mesh.size
+        if n_inst != cfg.n_instances:
+            cfg = dataclasses.replace(cfg, n_instances=n_inst)
         logger.info("instance axis sharded over %d devices", mesh.size)
         runner = lambda: sharded_sim.run_sharded(cfg, mesh, workload, gates)  # noqa: E731
     else:
@@ -193,12 +207,16 @@ def run_fast(args) -> int:
     n = args.cltcnt * args.idcnt
     quorum = args.srvcnt // 2 + 1
     vids = jnp.arange(n, dtype=jnp.int32)
+    n_devices = 1
+
     def _go():
+        nonlocal n_devices
         if args.mesh:
             from tpu_paxos.parallel import mesh as pmesh
             from tpu_paxos.parallel import sharded
 
-            mesh = pmesh.make_instance_mesh(args.mesh)
+            mesh = pmesh.make_instance_mesh(args.mesh, dcn_hosts=args.dcn_hosts)
+            n_devices = mesh.size  # may be fewer than requested
             st = sharded.init_sharded_state(mesh, n, args.srvcnt)
             step = sharded.sharded_choose_all(mesh, proposer=0, quorum=quorum)
             return step(st, pmesh.shard_instances(mesh, vids))
@@ -226,7 +244,7 @@ def run_fast(args) -> int:
     _emit(args, {
         "engine": "fast",
         "chosen": int(n_chosen),
-        "devices": args.mesh or 1,
+        "devices": n_devices,
         "invariants": ["agreement", "exactly_once"] if ok else [],
         "ok": ok and int(n_chosen) == n,
     })
@@ -371,7 +389,7 @@ def _emit(args, summary: dict) -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    _select_backend(args.backend)
+    _select_backend(args.backend, args.mesh)
     if args.engine == "sim":
         return run_sim(args)
     if args.engine == "fast":

@@ -330,7 +330,7 @@ def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
 def build_engine(
     cfg: SimConfig,
     n_pend_cap: int,
-    axis_name: str | None = None,
+    axis_name: str | tuple[str, ...] | None = None,
     n_shards: int = 1,
     vid_cap: int = 0,
 ):
@@ -338,14 +338,17 @@ def build_engine(
     state`` plus static geometry.  Everything data-dependent lives in
     the state; everything shape-like is baked in.
 
-    With ``axis_name`` set, the round function is the per-shard body of
+    With ``axis_name`` set (one mesh axis name, or a tuple of names
+    for the 2-D dcn x ici multi-host mesh — ``lax`` collectives and
+    ``axis_index`` reduce/linearize over the whole tuple), the round
+    function is the per-shard body of
     an instance-axis ``shard_map``: every [.., I, ..] array it sees is
     a shard of ``n_instances // n_shards`` instances (with the queue
     arrays per-shard private), instance indices are globalized via
     ``lax.axis_index``, and the handful of places where instance-axis
     information crosses shards — high-water marks, send predicates,
     gate membership, quiescence — become ``pmax``/``psum`` collectives
-    over ICI.  All [P]/[A]-shaped protocol state stays replicated: its
+    over ICI (and DCN between hosts on the 2-D mesh).  All [P]/[A]-shaped protocol state stays replicated: its
     updates are functions of replicated network arrivals and these
     global reductions, so every shard computes identical copies (the
     sharded-vs-unsharded equivalence test pins this).

@@ -17,9 +17,15 @@ Mesh axes:
   split on dim 0 (``shard_instances``).
 - per-acceptor scalars ([nodes]-shaped) are replicated.
 
-Multi-host: ``jax.distributed.initialize()`` + the same mesh spanning
-all processes gives the DCN scale-out path; the round functions are
-unchanged because shard_map hides the topology.
+Multi-host: a 2-D ``('dcn', 'i')`` mesh (``make_instance_mesh`` with
+``dcn_hosts > 1``) splits instances over hosts on the outer axis and
+over a host's chips on the inner one; the round functions are
+unchanged because every collective reduces over *all* mesh axes
+(``instance_axes``) and XLA routes each hop over the right fabric —
+ICI within a slice, DCN between hosts.  Production multi-process use
+is ``jax.distributed.initialize()`` + the same mesh over
+``jax.devices()``; here the 2-D path is exercised on a virtual
+device mesh (tests/test_multihost.py, the driver dryrun).
 """
 
 from __future__ import annotations
@@ -28,21 +34,45 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 INSTANCE_AXIS = "i"
+DCN_AXIS = "dcn"
 
 
-def make_instance_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """1-D mesh over the instance axis.  ``n_devices=None`` uses every
-    visible device (the v5e-8 slice in the target config)."""
+def make_instance_mesh(
+    n_devices: int | None = None, devices=None, dcn_hosts: int = 1
+) -> Mesh:
+    """Mesh over the instance axis.  ``n_devices=None`` uses every
+    visible device (the v5e-8 slice in the target config).  With
+    ``dcn_hosts > 1`` the mesh is 2-D ``(dcn_hosts, chips_per_host)``
+    with axes ``('dcn', 'i')`` — the multi-host shape."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
+    if dcn_hosts > 1:
+        # Degrade gracefully when fewer devices are visible than the
+        # caller planned for (the 1-D path's contract): clamp the host
+        # axis to the largest divisor of the device count.
+        import math
+
+        dcn_hosts = math.gcd(dcn_hosts, len(devices))
+        return jax.make_mesh(
+            (dcn_hosts, len(devices) // dcn_hosts),
+            (DCN_AXIS, INSTANCE_AXIS),
+            devices=devices,
+        )
     return jax.make_mesh((len(devices),), (INSTANCE_AXIS,), devices=devices)
 
 
-def instance_spec() -> P:
+def instance_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Every mesh axis shards the instance dimension; collectives
+    reduce over this whole tuple (a linear shard index comes from
+    ``jax.lax.axis_index(instance_axes(mesh))``)."""
+    return tuple(mesh.axis_names)
+
+
+def instance_spec(mesh: Mesh | None = None) -> P:
     """Spec for [instances, ...] arrays: split dim 0 over the mesh."""
-    return P(INSTANCE_AXIS)
+    return P(instance_axes(mesh) if mesh is not None else INSTANCE_AXIS)
 
 
 def replicated_spec() -> P:
@@ -51,4 +81,4 @@ def replicated_spec() -> P:
 
 def shard_instances(mesh: Mesh, arr):
     """Place an [I, ...] array sharded over the instance axis."""
-    return jax.device_put(arr, NamedSharding(mesh, instance_spec()))
+    return jax.device_put(arr, NamedSharding(mesh, instance_spec(mesh)))

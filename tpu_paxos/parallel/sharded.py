@@ -25,26 +25,30 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import fast
 from tpu_paxos.core import values as val
-from tpu_paxos.parallel.mesh import INSTANCE_AXIS
+from tpu_paxos.parallel.mesh import INSTANCE_AXIS, instance_axes
 
 
-def _state_specs() -> fast.FastState:
+def _state_specs(axes=INSTANCE_AXIS) -> fast.FastState:
     """PartitionSpec pytree for FastState: [A, I] arrays split over
-    the (minor) instance axis, [A] scalars replicated."""
+    the (minor) instance axis, [A] scalars replicated.  ``axes`` is
+    the mesh axis name (or tuple of names, for the 2-D dcn x ici
+    multi-host mesh) sharding the instance dimension."""
     return fast.FastState(
         promised=P(),
         max_seen=P(),
-        acc_ballot=P(None, INSTANCE_AXIS),
-        acc_vid=P(None, INSTANCE_AXIS),
-        learned=P(None, INSTANCE_AXIS),
+        acc_ballot=P(None, axes),
+        acc_vid=P(None, axes),
+        learned=P(None, axes),
     )
 
 
-def _choose_all_local(state: fast.FastState, vids, proposer: int, quorum: int):
+def _choose_all_local(
+    state: fast.FastState, vids, proposer: int, quorum: int, axes=INSTANCE_AXIS
+):
     """Per-shard body of the fused choose-all: identical to the
     single-chip fast path except the ballot is derived from the
     *global* max ballot seen (pmax over shards)."""
-    global_max = jax.lax.pmax(jnp.max(state.max_seen), INSTANCE_AXIS)
+    global_max = jax.lax.pmax(jnp.max(state.max_seen), axes)
     _, ballot = bal.bump_past(jnp.int32(0), jnp.int32(proposer), global_max)
 
     state, prepared, adopted_ballot, adopted_vid = fast.phase1_prepare(
@@ -57,7 +61,7 @@ def _choose_all_local(state: fast.FastState, vids, proposer: int, quorum: int):
     state = fast.phase3_learn(state, batch, chosen)
 
     local_chosen = jnp.sum((state.learned[0] != val.NONE).astype(jnp.int32))
-    n_chosen = jax.lax.psum(local_chosen, INSTANCE_AXIS)
+    n_chosen = jax.lax.psum(local_chosen, axes)
     return state, n_chosen
 
 
@@ -67,14 +71,15 @@ def sharded_choose_all(mesh: Mesh, proposer: int, quorum: int):
     Returns ``fn(state, vids) -> (state, n_chosen)`` where [I, ...]
     inputs are sharded over the instance axis.
     """
+    axes = instance_axes(mesh)
     body = functools.partial(
-        _choose_all_local, proposer=proposer, quorum=quorum
+        _choose_all_local, proposer=proposer, quorum=quorum, axes=axes
     )
     mapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(_state_specs(), P(INSTANCE_AXIS)),
-        out_specs=(_state_specs(), P()),
+        in_specs=(_state_specs(axes), P(axes)),
+        out_specs=(_state_specs(axes), P()),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -91,7 +96,8 @@ def init_sharded_state(mesh: Mesh, n_instances: int, n_nodes: int) -> fast.FastS
     from jax.sharding import NamedSharding
 
     shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), _state_specs(),
+        lambda spec: NamedSharding(mesh, spec),
+        _state_specs(instance_axes(mesh)),
         is_leaf=lambda x: isinstance(x, P),
     )
     return jax.tree.map(jax.device_put, state, shardings)

@@ -42,23 +42,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_paxos.config import SimConfig
 from tpu_paxos.core import sim as simm
 from tpu_paxos.core import values as val
-from tpu_paxos.parallel.mesh import INSTANCE_AXIS
+from tpu_paxos.parallel.mesh import INSTANCE_AXIS, instance_axes
 from tpu_paxos.utils import prng
 
-_I = P(INSTANCE_AXIS)
 
-
-def _state_specs() -> simm.SimState:
-    """PartitionSpec pytree for SimState under the instance mesh."""
+def _state_specs(axes=INSTANCE_AXIS) -> simm.SimState:
+    """PartitionSpec pytree for SimState under the instance mesh.
+    ``axes`` is the mesh axis name (or tuple of names for the 2-D
+    dcn x ici multi-host mesh) sharding the instance dimension."""
     return simm.SimState(
         t=P(),
         acc=simm.AcceptorState(
             promised=P(),
             max_seen=P(),
-            acc_ballot=P(None, INSTANCE_AXIS),
-            acc_vid=P(None, INSTANCE_AXIS),
+            acc_ballot=P(None, axes),
+            acc_vid=P(None, axes),
         ),
-        learned=P(None, INSTANCE_AXIS),
+        learned=P(None, axes),
         prop=simm.ProposerState(
             mode=P(),
             count=P(),
@@ -68,26 +68,29 @@ def _state_specs() -> simm.SimState:
             prep_deadline=P(),
             prep_retries=P(),
             promises=P(),
-            adopted_b=P(None, INSTANCE_AXIS),
-            adopted_v=P(None, INSTANCE_AXIS),
-            cur_batch=P(None, INSTANCE_AXIS),
-            acks=P(None, None, INSTANCE_AXIS),
+            adopted_b=P(None, axes),
+            adopted_v=P(None, axes),
+            cur_batch=P(None, axes),
+            acks=P(None, None, axes),
             acc_deadline=P(),
             acc_retries=P(),
-            own_assign=P(None, INSTANCE_AXIS),
+            own_assign=P(None, axes),
             # leading axis = shard (per-shard private queues)
-            pend=P(INSTANCE_AXIS, None, None),
-            gate=P(INSTANCE_AXIS, None, None),
-            head=P(INSTANCE_AXIS, None),
-            tail=P(INSTANCE_AXIS, None),
-            commit_vid=P(None, INSTANCE_AXIS),
-            commit_acked=P(None, None, INSTANCE_AXIS),
+            pend=P(axes, None, None),
+            gate=P(axes, None, None),
+            head=P(axes, None),
+            tail=P(axes, None),
+            commit_vid=P(None, axes),
+            commit_acked=P(None, None, axes),
             commit_deadline=P(),
             stall=P(),
         ),
         net=jax.tree.map(lambda _: P(), simm.netm.init_buffers(1, 1, 1)),
         met=simm.Metrics(
-            chosen_vid=_I, chosen_round=_I, chosen_ballot=_I, msgs=P()
+            chosen_vid=P(axes),
+            chosen_round=P(axes),
+            chosen_ballot=P(axes),
+            msgs=P(),
         ),
         crashed=P(),
         done=P(),
@@ -184,6 +187,22 @@ def split_workload(
     )
 
 
+def min_instances(
+    workload: list[np.ndarray],
+    gates: list[np.ndarray] | None,
+    n_shards: int,
+) -> int:
+    """Smallest mesh-aligned ``n_instances`` that gives every shard 2x
+    its largest workload: the chain-aware split keeps whole gate
+    chains on one shard, so per-shard demand is set by the biggest
+    component cluster, not ``total/n_shards``, and the 2x headroom
+    mirrors the unsharded harness sizing (conflict re-proposals and
+    hole-filling no-ops consume extra instances)."""
+    wls, _ = split_workload(workload, gates, n_shards)
+    max_load = max(sum(len(w) for w in wl) for wl in wls)
+    return n_shards * max(2 * max_load, 1)
+
+
 def prepare_queues_sharded(
     cfg: SimConfig,
     workload: list[np.ndarray],
@@ -231,7 +250,7 @@ def init_sharded_state(
     )
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        _state_specs(),
+        _state_specs(instance_axes(mesh)),
         is_leaf=lambda x: isinstance(x, P),
     )
     return jax.tree.map(jax.device_put, st, shardings)
@@ -255,12 +274,24 @@ def build_runner(
     if workload is None:
         workload = simm.default_workload(cfg)
     pend, gate, tail, c = prepare_queues_sharded(cfg, workload, gates, d)
+    # Liveness precondition: a shard cannot place more values than it
+    # has instances (instances are never reused) — undersized configs
+    # used to spin to max_rounds instead of failing fast.
+    max_load = int(tail.sum(axis=1).max())
+    if cfg.n_instances // d < max_load:
+        raise ValueError(
+            f"shard workload of {max_load} values exceeds "
+            f"{cfg.n_instances // d} instances per shard; need "
+            f"n_instances >= {min_instances(workload, gates, d)} "
+            f"(see min_instances)"
+        )
     root = prng.root_key(cfg.seed)
     state = init_sharded_state(cfg, mesh, pend, gate, tail, root)
+    axes = instance_axes(mesh)
     round_fn = simm.build_engine(
         cfg,
         c,
-        axis_name=INSTANCE_AXIS,
+        axis_name=axes,
         n_shards=d,
         vid_cap=simm.gates_vid_cap(workload, gates),
     )
@@ -276,7 +307,7 @@ def build_runner(
 
         return _wrap(jax.lax.while_loop(cond, step, st))
 
-    specs = _state_specs()
+    specs = _state_specs(axes)
     mapped = jax.jit(
         jax.shard_map(
             body,
