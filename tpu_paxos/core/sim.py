@@ -283,6 +283,23 @@ def _select_by_argmax(values_pi, cand_pai):
     return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
 
 
+def _window_ops(c: int, w: int):
+    """Contiguous-window read/write on one ring row ([C] <-> [W] at
+    absolute position h).  Rows are padded by W so ``dynamic_slice``
+    never clamps the start (h <= c always: h is head or tail, both
+    bounded by the capacity proof)."""
+
+    def read(row, h):
+        padded = jnp.concatenate([row, jnp.full((w,), val.NONE, row.dtype)])
+        return jax.lax.dynamic_slice(padded, (h,), (w,))
+
+    def write(row, wv, h):
+        padded = jnp.concatenate([row, jnp.full((w,), val.NONE, row.dtype)])
+        return jax.lax.dynamic_update_slice(padded, wv, (h,))[:c]
+
+    return read, write
+
+
 def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
     """First-fit view of the head window: which of the next W queue
     entries are live and gate-satisfied.  Gated entries (the in-order
@@ -307,16 +324,17 @@ def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
     profile — while the bitmap gather is O(W) on top of the O(I)
     scatter its caller pays once per round.
 
-    Returns (qpos [P, W] ring positions, qvid [P, W], ok [P, W])."""
+    Returns (qvid [P, W], ok [P, W])."""
     offs = jnp.arange(w)
-    qpos = jnp.clip(head[:, None] + offs[None], 0, c - 1)  # [P, W] absolute
-    live = ((head[:, None] + offs[None]) < tail[:, None]) & (
-        jnp.take_along_axis(pend, qpos, axis=1) != val.NONE
-    )
-    qvid = jnp.take_along_axis(pend, qpos, axis=1)
+    # The window is CONTIGUOUS from head, so reads are padded dynamic
+    # slices, not gathers (a [P, W] gather from the [P, C] ring was
+    # ~40% of the round's device time at W = 256k).
+    wread, _ = _window_ops(c, w)
+    qvid = jax.vmap(wread)(pend, head)
+    live = ((head[:, None] + offs[None]) < tail[:, None]) & (qvid != val.NONE)
     if chosen_mask is None:
-        return qpos, qvid, live
-    g = jnp.take_along_axis(gate, qpos, axis=1)  # [P, W]
+        return qvid, live
+    g = jax.vmap(wread)(gate, head)  # [P, W]
     v_cap = chosen_mask.shape[0]
     g_chosen = (
         chosen_mask[jnp.clip(g, 0, v_cap - 1)]
@@ -324,7 +342,7 @@ def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
         & (g < v_cap)  # gates on out-of-workload vids never satisfy
     )
     ok = live & ((g == val.NONE) | g_chosen)
-    return qpos, qvid, ok
+    return qvid, ok
 
 
 def build_engine(
@@ -585,7 +603,7 @@ def build_engine(
             ].set(True, mode="drop")
         else:
             chosen_mask = None  # gate-free run: no gate logic at all
-        qpos, qvid, ok = _assignable_window(
+        qvid, ok = _assignable_window(
             pr.pend, pr.gate, pr.head, pr.tail, chosen_mask, c,
             cfg.assign_window,
         )
@@ -616,17 +634,16 @@ def build_engine(
         newv = jax.vmap(_place)(by_rank, start)  # [P, I]
         cur_batch = jnp.where(takev, newv, cur_batch)
         own_assign = jnp.where(takev, newv, pr.own_assign)
-        # consume taken entries in place (scatter NONE at exactly the
-        # taken ring slots; untaken window positions are redirected out
-        # of range and dropped), then advance head over the leading
-        # consumed run
-        pos_taken = jnp.where(take_q, qpos, c)
-        pend = pr.pend.at[prow, pos_taken].set(
-            jnp.full_like(qpos, val.NONE), mode="drop"
-        )
+        # consume taken entries in place: the window is contiguous from
+        # head, so this is a masked window write-back, not a scatter
+        # (positions beyond tail hold NONE in qvid and rewrite NONE);
+        # then advance head over the leading consumed run
+        new_win = jnp.where(take_q, val.NONE, qvid)  # [P, W]
+        _, wwrite = _window_ops(c, w)
+        pend = jax.vmap(wwrite)(pr.pend, new_win, pr.head)
         lead_dead = (
             (pr.head[:, None] + jnp.arange(w)[None]) < pr.tail[:, None]
-        ) & (jnp.take_along_axis(pend, qpos, axis=1) == val.NONE)
+        ) & (new_win == val.NONE)
         head = pr.head + jnp.sum(
             jnp.cumprod(lead_dead.astype(jnp.int32), axis=1), axis=1
         )
@@ -694,18 +711,54 @@ def build_engine(
         own_has2 = own_assign != val.NONE
         conflict = own_has2 & (learned_p != val.NONE) & (learned_p != own_assign)
         own_done = own_has2 & (learned_p == own_assign)
-        nreq = jnp.sum(conflict, axis=1)  # [P]
+        # Requeue at most assign_window conflicts per round, in
+        # instance order; the remainder keep their own_assign entry and
+        # are re-detected next round (drain rate >= the assignment
+        # rate, so the cap never throttles below the proposer's own
+        # placement throughput).  The conflicted vids are compacted by
+        # a pair sort and appended with ONE contiguous block write at
+        # the tail — replacing a [P, I]-indexed ring scatter that
+        # serialized on TPU (~40% of round wall time at I >= 1M).
+        r_cap = min(cfg.assign_window, i_loc)
         req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
-        # scatter requeued vids at the queue tail (absolute positions,
-        # capacity-proof — see prepare_queues; non-conflict rows are
-        # redirected out of range and dropped)
-        req_pos = jnp.where(conflict, pr.tail[:, None] + req_rank, c)
-        pend = pend.at[prow, req_pos].set(own_assign, mode="drop")
-        gate = pr.gate.at[prow, req_pos].set(  # requeues are ungated
-            jnp.full_like(req_pos, val.NONE), mode="drop"
+        take_req = conflict & (req_rank < r_cap)
+        nreq = jnp.sum(take_req, axis=1)  # [P]
+        # Most rounds have no conflicts at all, so the sort runs under
+        # a cond; the predicate is global (gany) so every shard takes
+        # the same branch and no collective sits inside it.
+        any_conflict = gany(jnp.any(conflict))
+
+        def _do_requeue(pend, own_assign, ptail):
+            sort_keys = jnp.where(
+                conflict, jnp.broadcast_to(idx[None], conflict.shape),
+                jnp.int32(i_cap),
+            )
+            _, sort_vids = jax.lax.sort(
+                (sort_keys, own_assign), dimension=1, num_keys=1
+            )
+            req_block = jnp.where(
+                jnp.arange(r_cap)[None] < nreq[:, None],
+                sort_vids[:, :r_cap],
+                val.NONE,
+            )  # [P, R]
+            # Slots >= tail are NONE by construction (tail is
+            # monotone; nothing ever writes past it), so block
+            # positions beyond nreq overwrite NONE with NONE
+            # (capacity proof: tail + nreq <= c, see prepare_queues).
+            _, wwrite_r = _window_ops(c, r_cap)
+            return jax.vmap(wwrite_r)(pend, req_block, ptail)
+
+        pend = jax.lax.cond(
+            any_conflict,
+            _do_requeue,
+            lambda pend, own_assign, ptail: pend,
+            pend, own_assign, pr.tail,
         )
+        # gate slots >= tail are NONE from init (requeues are ungated
+        # by construction), so no gate write is needed.
+        gate = pr.gate
         tail = pr.tail + nreq
-        own_assign = jnp.where(conflict | own_done, val.NONE, own_assign)
+        own_assign = jnp.where(take_req | own_done, val.NONE, own_assign)
 
         # ---------------- timers / mode ladder ----------------
         # PREPARING deadline: resend (count-1 times) then restart with
@@ -980,6 +1033,14 @@ def prepare_queues(
         tail[pi] = len(wl)
         if gates is not None and len(gates[pi]):
             g = np.asarray(gates[pi], np.int32)
+            if len(g) > len(wl):
+                # load-bearing for the requeue path: gate slots at and
+                # past tail must be NONE (requeues are appended there
+                # ungated, without a clearing write)
+                raise ValueError(
+                    f"gates for proposer {pi} ({len(g)}) exceed its "
+                    f"workload ({len(wl)})"
+                )
             gate[pi, : len(g)] = g
     return pend, gate, tail, c
 
