@@ -272,7 +272,7 @@ def bench_sharded_child() -> list[dict]:
     )
 
     # general engine, sharded, reference fault rates
-    i = int(os.environ.get("TPU_PAXOS_BENCH_SIM_SHARDED_INSTANCES", 1 << 18))
+    i = int(os.environ.get("TPU_PAXOS_BENCH_SIM_SHARDED_INSTANCES", 1 << 20))
     cfg = SimConfig(
         n_nodes=7,
         n_instances=i,

@@ -286,3 +286,39 @@ def test_message_counters_populated():
     assert r.msgs[0] > 0 and r.msgs[1] > 0
     assert r.msgs[3] > 0 and r.msgs[4] > 0
     assert r.msgs[5] > 0 and r.msgs[6] > 0
+
+
+def test_conflict_requeue_cap_carry_over():
+    """More simultaneous conflicts than the per-round requeue cap
+    (assign_window): the overflow must stay in own_assign and drain on
+    later rounds — no conflicted value may be lost.  12 own
+    assignments all lose to rival pre-accepted values with a 4-wide
+    window, so the requeue compaction needs 3+ rounds to drain."""
+    k = 12
+    cfg = SimConfig(
+        n_nodes=3, n_instances=64, proposers=(0,), seed=0, assign_window=4
+    )
+    workload = [np.zeros((0,), np.int32)]
+    pend, gate, tail, c = sim.prepare_queues(cfg, workload)
+    root = prng.root_key(cfg.seed)
+    st = sim.init_state(cfg, pend, gate, tail, root)
+    rival = int(bal.make(7, 1))
+    insts = np.arange(k)
+    st = st._replace(
+        acc=st.acc._replace(
+            acc_ballot=st.acc.acc_ballot.at[1, insts].set(rival),
+            acc_vid=st.acc.acc_vid.at[1, insts].set(700 + insts),
+        ),
+        prop=st.prop._replace(
+            own_assign=st.prop.own_assign.at[0, insts].set(100 + insts),
+        ),
+    )
+    expected = np.concatenate([100 + insts, 700 + insts]).astype(np.int32)
+    r = sim.run_state(cfg, st, root, expected, c)
+    assert r.done
+    # every rival won its original instance; every displaced own value
+    # was re-chosen elsewhere, exactly once
+    assert (r.chosen_vid[:k] == 700 + insts).all()
+    chosen = set(r.chosen_vid[r.chosen_vid >= 0].tolist())
+    assert set((100 + insts).tolist()) <= chosen
+    validate.check_all(r.learned, expected)
