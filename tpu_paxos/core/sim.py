@@ -611,27 +611,41 @@ def build_engine(
         k = jnp.minimum(jnp.sum(ok, axis=1), n_free)
         k = jnp.where(can_assign, k, 0)
         take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
-        # vid of the r-th taken entry by rank: an O(W) rank scatter
-        # (taken entries have distinct ranks; untaken slots are routed
-        # out of range and dropped) — an equality one-hot here would
-        # cost O(W^2) and cap the window size
         w = cfg.assign_window
         prow = jnp.arange(p)[:, None]
-        rank_pos = jnp.where(take_q, ok_rank, w)  # [P, W]
-        by_rank = jnp.full((p, w), val.NONE, jnp.int32).at[prow, rank_pos].set(
-            qvid, mode="drop"
-        )  # [P, R]
         takev = free & (free_rank < k[:, None])  # instances filled
-        # place the ranked vids at the contiguous free window: a
-        # padded dynamic-slice write (start is always in [0, i_loc],
-        # so nothing clamps or shifts), truncated back to shard size
         start = jnp.clip(hi2l + 1 - off, 0, i_loc)
+        # Rounds with nothing to assign (most of a long run) skip the
+        # rank scatter entirely; the predicate is global so every
+        # shard branches identically.
+        any_assign = gany(jnp.any(k > 0))
 
-        def _place(br, h):
-            buf = jnp.full((i_loc + w,), val.NONE, jnp.int32)
-            return jax.lax.dynamic_update_slice(buf, br, (h,))[:i_loc]
+        def _compute_newv(qvid_, take_q_, start_):
+            # vid of the r-th taken entry by rank: an O(W) rank
+            # scatter (taken entries have distinct ranks; untaken
+            # slots are routed out of range and dropped) — an equality
+            # one-hot here would cost O(W^2) and cap the window size
+            rank_pos = jnp.where(take_q_, ok_rank, w)  # [P, W]
+            by_rank = jnp.full((p, w), val.NONE, jnp.int32).at[
+                prow, rank_pos
+            ].set(qvid_, mode="drop")
 
-        newv = jax.vmap(_place)(by_rank, start)  # [P, I]
+            # place the ranked vids at the contiguous free window: a
+            # padded dynamic-slice write (start is always in
+            # [0, i_loc], so nothing clamps or shifts), truncated back
+            # to shard size
+            def _place(br, h):
+                buf = jnp.full((i_loc + w,), val.NONE, jnp.int32)
+                return jax.lax.dynamic_update_slice(buf, br, (h,))[:i_loc]
+
+            return jax.vmap(_place)(by_rank, start_)
+
+        newv = jax.lax.cond(
+            any_assign,
+            _compute_newv,
+            lambda *_: jnp.full((p, i_loc), val.NONE, jnp.int32),
+            qvid, take_q, start,
+        )  # [P, I]
         cur_batch = jnp.where(takev, newv, cur_batch)
         own_assign = jnp.where(takev, newv, pr.own_assign)
         # consume taken entries in place: the window is contiguous from
