@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test bench bench-sharded parity parity-fast replay-diff run clean
+.PHONY: test bench bench-sharded parity parity-fast replay-diff run stress clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -30,6 +30,12 @@ parity-fast:
 # ref member/diff.sh).
 replay-diff:
 	$(PY) -m pytest tests/test_replay.py -x -q
+
+# Randomized sweep: seeds x fault mixes through the general engine,
+# full invariant suite on every run (the reference's stated purpose,
+# beyond the fixed-seed tests).  SEEDS=n overrides seeds per mix.
+stress:
+	$(PY) -m tpu_paxos.harness.stress --seeds $(or $(SEEDS),8)
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

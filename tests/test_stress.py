@@ -1,0 +1,14 @@
+"""Smoke the randomized stress sweep (full sweep is `make stress`)."""
+
+from tpu_paxos.harness import stress
+
+
+def test_stress_sweep_smoke(monkeypatch):
+    # two representative mixes, one seed each — the full grid runs via
+    # `make stress`
+    monkeypatch.setattr(
+        stress, "MIXES", [stress.MIXES[1], stress.MIXES[4]]
+    )
+    summary = stress.sweep(n_seeds=1, verbose=False)
+    assert summary["ok"], summary["failures"]
+    assert summary["runs"] == 2

@@ -1117,6 +1117,12 @@ def run_state(
         return jax.lax.while_loop(cond, body, state)
 
     final = _go(root, state)
+    return to_result(final, expected_vids)
+
+
+def to_result(final: SimState, expected_vids: np.ndarray) -> SimResult:
+    """Marshal a final device state into the host-convention result
+    (shared by run_state, the sharded runner, and the stress sweep)."""
     return SimResult(
         learned=np.asarray(final.learned).T,  # host convention [I, A]
         chosen_vid=np.asarray(final.met.chosen_vid),

@@ -326,17 +326,7 @@ def build_runner(
 
 
 def to_result(final: simm.SimState, expected: np.ndarray) -> simm.SimResult:
-    return simm.SimResult(
-        learned=np.asarray(final.learned).T,  # host convention [I, A]
-        chosen_vid=np.asarray(final.met.chosen_vid),
-        chosen_round=np.asarray(final.met.chosen_round),
-        chosen_ballot=np.asarray(final.met.chosen_ballot),
-        rounds=int(final.t),
-        done=bool(final.done),
-        crashed=np.asarray(final.crashed),
-        msgs=np.asarray(final.met.msgs),
-        expected_vids=expected,
-    )
+    return simm.to_result(final, expected)
 
 
 def run_sharded(
