@@ -420,11 +420,6 @@ def build_engine(
         # ---------------- acceptor side ----------------
         acc = st.acc
         learned = st.learned
-        # Snapshot (pre-accept) for prepare replies; committed values
-        # are included at COMMITTED_BALLOT (ref FilterAcceptedValues
-        # includes committed_values_, multi/paxos.cpp:913-922).
-        snap_b = jnp.where(learned != val.NONE, COMMITTED_BALLOT, acc.acc_ballot)
-        snap_v = jnp.where(learned != val.NONE, learned, acc.acc_vid)
 
         # PREPARE arrivals (crashed acceptors ignore everything).
         preq = jnp.where(alive_a[None, :], ar.prep_req, bal.NONE)  # [P, A]
@@ -492,35 +487,57 @@ def build_engine(
 
         # PREPARE_REPLY arrivals: promises + adoption merge.  The
         # accepted-state snapshot is the acceptor's state at delivery
-        # (the pre-round snap_b/snap_v above) — equivalent to the
-        # acceptor processing the prepare at the delivery round, which
-        # is strictly safer: its promise took effect earlier, and a
-        # fresher snapshot's max-ballot value is exactly what a
-        # later-generated reply would report.
+        # (the pre-round snap_b/snap_v inside _adopt below) —
+        # equivalent to the acceptor processing the prepare at the
+        # delivery round, which is strictly safer: its promise took
+        # effect earlier, and a fresher snapshot's max-ballot value is
+        # exactly what a later-generated reply would report.
         pecho = jnp.where(alive_a[:, None], ar.prep_echo, bal.NONE)  # [A, P]
         match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
         promises2 = pr.promises | match.T  # [P, A]
-        # Adoption merge as two fused masked-max passes (argmax +
-        # take_along_axis gather cost ~1/3 of the whole round's wall
-        # time at 1M instances).  Exact: cells tied at the max ballot
-        # hold the same value — one proposer per ballot sends one
-        # value per instance, and committed-sentinel rows all hold the
-        # agreed chosen value.
-        rep_mask = match.T[:, :, None]  # [P, A, 1]
-        best_b = jnp.max(
-            jnp.where(rep_mask, snap_b[None], bal.NONE), axis=1
-        )  # [P, I]
-        best_v = jnp.max(
-            jnp.where(
-                rep_mask & (snap_b[None] == best_b[:, None, :]),
-                snap_v[None],
-                _NEG,
-            ),
-            axis=1,
+        # Prepare replies only arrive while some proposer is in its
+        # (rare) phase-1 — the acceptor snapshot and the [P, A, I]
+        # adoption passes run under a cond (global predicate: every
+        # shard branches identically).
+        any_reply = gany(jnp.any(match))
+
+        def _adopt(ab, av):
+            # Accepted-state snapshot at delivery (pre-round state —
+            # st.acc / st.learned, NOT this round's updates);
+            # committed values are included at COMMITTED_BALLOT (ref
+            # FilterAcceptedValues includes committed_values_,
+            # multi/paxos.cpp:913-922).
+            snap_b = jnp.where(
+                st.learned != val.NONE, COMMITTED_BALLOT, st.acc.acc_ballot
+            )
+            snap_v = jnp.where(
+                st.learned != val.NONE, st.learned, st.acc.acc_vid
+            )
+            # Adoption merge as two fused masked-max passes (argmax +
+            # take_along_axis gather cost ~1/3 of the whole round's
+            # wall time at 1M instances).  Exact: cells tied at the
+            # max ballot hold the same value — one proposer per ballot
+            # sends one value per instance, and committed-sentinel
+            # rows all hold the agreed chosen value.
+            rep_mask = match.T[:, :, None]  # [P, A, 1]
+            best_b = jnp.max(
+                jnp.where(rep_mask, snap_b[None], bal.NONE), axis=1
+            )  # [P, I]
+            best_v = jnp.max(
+                jnp.where(
+                    rep_mask & (snap_b[None] == best_b[:, None, :]),
+                    snap_v[None],
+                    _NEG,
+                ),
+                axis=1,
+            )
+            take = (best_b != bal.NONE) & (best_b > ab)
+            return jnp.where(take, best_b, ab), jnp.where(take, best_v, av)
+
+        adopted_b, adopted_v = jax.lax.cond(
+            any_reply, _adopt, lambda ab, av: (ab, av),
+            pr.adopted_b, pr.adopted_v,
         )
-        take = (best_b != bal.NONE) & (best_b > pr.adopted_b)
-        adopted_b = jnp.where(take, best_b, pr.adopted_b)
-        adopted_v = jnp.where(take, best_v, pr.adopted_v)
 
         # Phase-1 quorum -> PREPARED; build the accept batch skeleton
         # (adopted values + noop hole fills + own initial proposals;
@@ -734,21 +751,28 @@ def build_engine(
         # the tail — replacing a [P, I]-indexed ring scatter that
         # serialized on TPU (~40% of round wall time at I >= 1M).
         r_cap = min(cfg.assign_window, i_loc)
-        req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
-        take_req = conflict & (req_rank < r_cap)
-        nreq = jnp.sum(take_req, axis=1)  # [P]
-        # Most rounds have no conflicts at all, so the sort runs under
-        # a cond; the predicate is global (gany) so every shard takes
-        # the same branch and no collective sits inside it.
+        # Most rounds have no conflicts at all, so the whole requeue —
+        # the rank cumsum, the compaction sort, and the tail append —
+        # runs under a cond; the predicate is global (gany) so every
+        # shard takes the same branch and no collective sits inside.
         any_conflict = gany(jnp.any(conflict))
 
         def _do_requeue(pend, own_assign, ptail):
+            req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
+            take_req = conflict & (req_rank < r_cap)
+            nreq = jnp.sum(take_req, axis=1)  # [P]
             sort_keys = jnp.where(
                 conflict, jnp.broadcast_to(idx[None], conflict.shape),
                 jnp.int32(i_cap),
             )
+            # unstable: conflict keys are unique global ids, and the
+            # sentinel-keyed remainder is discarded (a stable sort
+            # would pay for a third, hidden iota operand)
             _, sort_vids = jax.lax.sort(
-                (sort_keys, own_assign), dimension=1, num_keys=1
+                (sort_keys, own_assign),
+                dimension=1,
+                num_keys=1,
+                is_stable=False,
             )
             req_block = jnp.where(
                 jnp.arange(r_cap)[None] < nreq[:, None],
@@ -760,19 +784,24 @@ def build_engine(
             # positions beyond nreq overwrite NONE with NONE
             # (capacity proof: tail + nreq <= c, see prepare_queues).
             _, wwrite_r = _window_ops(c, r_cap)
-            return jax.vmap(wwrite_r)(pend, req_block, ptail)
+            pend = jax.vmap(wwrite_r)(pend, req_block, ptail)
+            own2 = jnp.where(take_req | own_done, val.NONE, own_assign)
+            return pend, nreq, own2
 
-        pend = jax.lax.cond(
+        pend, nreq, own_assign = jax.lax.cond(
             any_conflict,
             _do_requeue,
-            lambda pend, own_assign, ptail: pend,
+            lambda pend, own_assign, ptail: (
+                pend,
+                jnp.zeros((p,), jnp.int32),
+                jnp.where(own_done, val.NONE, own_assign),
+            ),
             pend, own_assign, pr.tail,
         )
         # gate slots >= tail are NONE from init (requeues are ungated
         # by construction), so no gate write is needed.
         gate = pr.gate
         tail = pr.tail + nreq
-        own_assign = jnp.where(take_req | own_done, val.NONE, own_assign)
 
         # ---------------- timers / mode ladder ----------------
         # PREPARING deadline: resend (count-1 times) then restart with
