@@ -35,7 +35,7 @@ replay-diff:
 # full invariant suite on every run (the reference's stated purpose,
 # beyond the fixed-seed tests).  SEEDS=n overrides seeds per mix.
 stress:
-	$(PY) -m tpu_paxos.harness.stress --seeds $(or $(SEEDS),8)
+	$(PY) -m tpu_paxos.harness.stress --seeds $(or $(SEEDS),8) --sharded
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

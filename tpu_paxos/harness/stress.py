@@ -190,14 +190,124 @@ def sweep(n_seeds: int = 8, base_seed: int = 0, verbose: bool = True) -> dict:
     }
 
 
+def sweep_sharded(
+    n_seeds: int = 2, base_seed: int = 0, verbose: bool = True
+) -> dict:
+    """The debug.conf and crashy mixes through the SHARDED engine on
+    the current device mesh (run under a virtual multi-device CPU
+    backend via ``--sharded``, which re-execs in a clean subprocess).
+    Chains stay shard-affine via split_workload, so the same
+    crash-aware invariant suite applies."""
+    import jax
+
+    from tpu_paxos.parallel import mesh as pmesh
+    from tpu_paxos.parallel import sharded_sim
+
+    logger = logm.get_logger(
+        "stress", logm.parse_level("INFO" if verbose else "WARN")
+    )
+    mesh = pmesh.make_instance_mesh()
+    runs, failures = 0, []
+    t0 = time.perf_counter()
+    for label, fkw, n_nodes, n_prop in (MIXES[1], MIXES[4]):
+        for s in range(n_seeds):
+            seed = base_seed + s
+            rng = np.random.default_rng(
+                seed * 7919 + zlib.crc32(label.encode()) % 1000
+            )
+            workload, gates, chains = _workload(n_prop, rng)
+            n_inst = 2 * sum(len(w) for w in workload)
+            n_inst = max(
+                n_inst + (-n_inst) % mesh.size,
+                sharded_sim.min_instances(workload, gates, mesh.size),
+            )
+            cfg = SimConfig(
+                n_nodes=n_nodes,
+                n_instances=n_inst,
+                proposers=tuple(range(n_prop)),
+                seed=seed,
+                max_rounds=20_000,
+                faults=FaultConfig(**fkw),
+            )
+            r = sharded_sim.run_sharded(cfg, mesh, workload, gates)
+            runs += 1
+            try:
+                if not r.done:
+                    raise validate.InvariantViolation(
+                        f"no quiescence in {r.rounds} rounds"
+                    )
+                _validate_run(r, cfg, workload, chains)
+            except validate.InvariantViolation as e:
+                failures.append(
+                    {"mix": label, "seed": seed, "error": str(e)[:300]}
+                )
+                logger.error("FAIL sharded mix=%s seed=%d: %s", label, seed, e)
+        logger.info("sharded mix %-11s: %d seeds done", label, n_seeds)
+    return {
+        "metric": "stress_sweep_sharded",
+        "runs": runs,
+        "devices": mesh.size,
+        "platform": jax.devices()[0].platform,
+        "failures": failures,
+        "ok": not failures,
+        "seconds": round(time.perf_counter() - t0, 1),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=8, help="seeds per mix")
     ap.add_argument("--base-seed", type=int, default=0)
+    ap.add_argument(
+        "--sharded",
+        action="store_true",
+        help="also run the sharded engine on an 8-device virtual CPU "
+        "mesh (subprocess)",
+    )
     args = ap.parse_args(argv)
     summary = sweep(args.seeds, args.base_seed)
     print(json.dumps(summary))
-    return 0 if summary["ok"] else 1
+    ok = summary["ok"]
+    if args.sharded:
+        import os
+        import subprocess
+
+        import __graft_entry__ as ge
+
+        code = ge.virtual_cpu_bootstrap(8) + (
+            "import json\n"
+            "from tpu_paxos.harness import stress\n"
+            f"s = stress.sweep_sharded(n_seeds=2, base_seed={args.base_seed})\n"
+            "print('STRESS_SHARDED:' + json.dumps(s))\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=ge._spawn_env(8),
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            capture_output=True,
+            text=True,
+            timeout=1200,
+        )
+        out = [
+            ln for ln in proc.stdout.splitlines()
+            if ln.startswith("STRESS_SHARDED:")
+        ]
+        if proc.returncode != 0 or not out:
+            print(
+                json.dumps(
+                    {
+                        "metric": "stress_sweep_sharded",
+                        "ok": False,
+                        "error": proc.stderr[-500:],
+                    }
+                )
+            )
+            ok = False
+        else:
+            sharded = json.loads(out[0][len("STRESS_SHARDED:"):])
+            print(json.dumps(sharded))
+            ok = ok and sharded["ok"]
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
