@@ -413,18 +413,23 @@ def main() -> None:
         if profile_dir
         else contextlib.nullcontext()
     )
+    # Median of three timed calls: per-dispatch latency through the
+    # device tunnel varies run to run, and the metric of record should
+    # not inherit that jitter.
+    dts = []
     with trace:
-        t0 = time.perf_counter()
-        state3, total = step(state2, vids0)
-        total.block_until_ready()
-        dt = time.perf_counter() - t0
-
-    n_chosen = _total(total)
-    assert n_chosen == n_inst * reps, f"bench chose {n_chosen}"
-    rate = n_chosen / dt
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state2, total = step(state2, vids0)
+            total.block_until_ready()
+            dts.append(time.perf_counter() - t0)
+            n_chosen = _total(total)
+            assert n_chosen == n_inst * reps, f"bench chose {n_chosen}"
+    dt = sorted(dts)[1]
+    rate = n_inst * reps / dt
     # Release the headline run's device state (~8 GiB on TPU) before
     # the secondary engines run on the same chip.
-    del state, state2, state3, total, vids0, step
+    del state, state2, total, vids0, step
 
     # Secondary records: the general engine on this backend, and the
     # sharded fast+sim engines on an 8-device virtual CPU mesh (no
