@@ -1,0 +1,30 @@
+"""Every example in examples/ must run green (subprocess, CPU)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "0*.py")))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_runs(path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, path],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "green" in p.stdout or "identically" in p.stdout
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 4
