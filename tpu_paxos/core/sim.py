@@ -753,30 +753,76 @@ def build_engine(
         r_cap = min(cfg.assign_window, i_loc)
         # Most rounds have no conflicts at all, so the whole requeue —
         # the rank cumsum, the compaction sort, and the tail append —
-        # runs under a cond; the predicate is global (gany) so every
-        # shard takes the same branch and no collective sits inside.
+        # runs under a cond.  The predicate MUST stay global (gany):
+        # every shard has to take the same branch, because a
+        # collective (the narrow/full sort-width vote below) now lives
+        # inside the taken branch.
         any_conflict = gany(jnp.any(conflict))
+
+        # Compaction-sort width: conflicts cluster around the frontier
+        # (both duelists assign the same lowest-free window), so when
+        # every proposer's conflict spread fits a 2*r_cap window the
+        # sort runs at that width; sparse spreads (crash leftovers,
+        # capped carry-overs drifting from a new wave) fall back to
+        # the full instance width.  Both branches produce the same
+        # first-r_cap-by-instance-order prefix.
+        span = min(2 * r_cap, i_loc)
 
         def _do_requeue(pend, own_assign, ptail):
             req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
             take_req = conflict & (req_rank < r_cap)
             nreq = jnp.sum(take_req, axis=1)  # [P]
-            sort_keys = jnp.where(
-                conflict, jnp.broadcast_to(idx[None], conflict.shape),
-                jnp.int32(i_cap),
+            idxb = jnp.broadcast_to(idx[None], conflict.shape)
+            has_c = jnp.any(conflict, axis=1)  # [P]
+            cmin = jnp.min(
+                jnp.where(conflict, idxb, jnp.iinfo(jnp.int32).max), axis=1
             )
-            # unstable: conflict keys are unique global ids, and the
-            # sentinel-keyed remainder is discarded (a stable sort
-            # would pay for a third, hidden iota operand)
-            _, sort_vids = jax.lax.sort(
-                (sort_keys, own_assign),
-                dimension=1,
-                num_keys=1,
-                is_stable=False,
+            cmax = jnp.max(jnp.where(conflict, idxb, -1), axis=1)
+            fits = jnp.all(~has_c | (cmax - cmin < span))
+            narrow = gall(fits)
+
+            # unstable sorts throughout: conflict keys are unique
+            # (global ids / window offsets) and the sentinel-keyed
+            # remainder is discarded (a stable sort would pay for a
+            # third, hidden iota operand)
+            def _sort_narrow(own_assign):
+                start = jnp.clip(
+                    jnp.where(has_c, cmin - off, 0), 0, i_loc - span
+                )
+
+                def _slice(row, h):
+                    return jax.lax.dynamic_slice(row, (h,), (span,))
+
+                win_conf = jax.vmap(_slice)(conflict, start)
+                win_vids = jax.vmap(_slice)(own_assign, start)
+                keys = jnp.where(
+                    win_conf,
+                    jnp.broadcast_to(
+                        jnp.arange(span, dtype=jnp.int32)[None],
+                        win_conf.shape,
+                    ),
+                    jnp.int32(span),
+                )
+                _, sv = jax.lax.sort(
+                    (keys, win_vids), dimension=1, num_keys=1,
+                    is_stable=False,
+                )
+                return sv[:, :r_cap]
+
+            def _sort_full(own_assign):
+                sort_keys = jnp.where(conflict, idxb, jnp.int32(i_cap))
+                _, sv = jax.lax.sort(
+                    (sort_keys, own_assign), dimension=1, num_keys=1,
+                    is_stable=False,
+                )
+                return sv[:, :r_cap]
+
+            sort_prefix = jax.lax.cond(
+                narrow, _sort_narrow, _sort_full, own_assign
             )
             req_block = jnp.where(
                 jnp.arange(r_cap)[None] < nreq[:, None],
-                sort_vids[:, :r_cap],
+                sort_prefix,
                 val.NONE,
             )  # [P, R]
             # Slots >= tail are NONE by construction (tail is
