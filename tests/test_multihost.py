@@ -5,7 +5,6 @@ collectives reduce over the full axis tuple; production use swaps the
 virtual devices for jax.distributed processes, nothing else changes).
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
