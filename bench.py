@@ -270,6 +270,46 @@ def bench_sharded_child() -> list[dict]:
             },
         }
     )
+    del step, state, state2, vids0, total
+
+    # same shape on the 2-D multi-host (dcn x ici) mesh — the
+    # collectives reduce over both axes; results are bit-identical to
+    # the 1-D mesh (tests/test_multihost.py), so this record is about
+    # the topology executing at size, not a new number
+    if n_dev % 2 == 0:
+        os.environ["TPU_PAXOS_BENCH_DCN_HOSTS"] = "2"
+        try:
+            mesh2, step2, st2, v2, n_inst2 = _sharded_fast_setup(
+                n_nodes, n_fast, reps, donate=True
+            )
+            st2b, total = step2(st2, v2)
+            total.block_until_ready()
+            t0 = time.perf_counter()
+            _, total = step2(st2b, v2)
+            total.block_until_ready()
+            dt = time.perf_counter() - t0
+            assert _total(total) == n_inst2 * reps
+            records.append(
+                {
+                    "engine": "fast",
+                    "baseline_config": 4,
+                    "metric": "paxos_instances_per_sec_to_chosen",
+                    "value": round(n_inst2 * reps / dt, 1),
+                    "unit": "instances/sec",
+                    "config": {
+                        "n_nodes": n_nodes,
+                        "n_instances_per_window": n_inst2,
+                        "windows": reps,
+                        "sharded": True,
+                        "mesh": "2x%d dcn x ici" % (n_dev // 2),
+                        "devices": n_dev,
+                        "platform": platform,
+                    },
+                }
+            )
+            del mesh2, step2, st2, st2b, v2, total
+        finally:
+            os.environ.pop("TPU_PAXOS_BENCH_DCN_HOSTS", None)
 
     # general engine, sharded, reference fault rates
     i = int(os.environ.get("TPU_PAXOS_BENCH_SIM_SHARDED_INSTANCES", 1 << 20))
