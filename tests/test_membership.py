@@ -335,3 +335,24 @@ def test_churn_with_crashes_survivors_progress():
     assert sorted(logs[0].tolist()) == sorted(proposed)
     counts = np.unique(logs[0], return_counts=True)[1]
     assert (counts == 1).all()
+
+
+def test_churn_at_config5_literal_size():
+    """BASELINE config 5 at its literal size: reconfiguration churn
+    with a 1M-instance log (grow 1->7 with values in flight, shrink
+    back to 5, Applied sequencing, prefix consistency)."""
+    ms = MemberSim(n_nodes=7, n_instances=1 << 20, seed=5)
+    vid = 100
+    for tgt in range(1, 7):
+        ms.propose(0, vid)
+        vid += 1
+        cv = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(cv), max_rounds=4000), tgt
+    for tgt in (6, 5):
+        cv = ms.del_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(cv), max_rounds=4000), tgt
+    assert ms.run_until(
+        lambda: all(ms.chosen(v) for v in range(100, vid)), max_rounds=4000
+    )
+    validate.check_prefix_consistency([ms.applied_log(i) for i in range(7)])
+    assert sorted(ms.acceptor_set(0)) == [0, 1, 2, 3, 4]
