@@ -50,6 +50,12 @@ MIXES = [
         5,
         2,
     ),
+    (
+        "delay-heavy",
+        dict(drop_rate=200, dup_rate=200, min_delay=1, max_delay=6),
+        7,
+        2,
+    ),
 ]
 
 N_IDS = 6  # ids per client chain (gated, in-order)
