@@ -128,8 +128,11 @@ class ProposerState(NamedTuple):
     acc_deadline: jax.Array  # [P] int32
     acc_retries: jax.Array  # [P] int32
     own_assign: jax.Array  # [P, I] int32 own initial proposals by instance
-    pend: jax.Array  # [P, C] int32 pending-value ring
-    gate: jax.Array  # [P, C] int32 vid that must be chosen first (NONE free)
+    pend: jax.Array  # [P, C+W] int32 pending-value ring (W-padded, see
+    #     prepare_queues: [C, C+W) is invariantly NONE so window ops
+    #     are clamp-free dynamic slices)
+    gate: jax.Array  # [P, C+W] int32 vid that must be chosen first (NONE
+    #     free); padded like pend
     head: jax.Array  # [P] int32 ring head (absolute)
     tail: jax.Array  # [P] int32 ring tail (absolute)
     commit_vid: jax.Array  # [P, I] int32 values this proposer is committing
@@ -283,24 +286,23 @@ def _select_by_argmax(values_pi, cand_pai):
     return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
 
 
-def _window_ops(c: int, w: int):
-    """Contiguous-window read/write on one ring row ([C] <-> [W] at
-    absolute position h).  Rows are padded by W so ``dynamic_slice``
-    never clamps the start (h <= c always: h is head or tail, both
-    bounded by the capacity proof)."""
+def _window_ops(w: int):
+    """Contiguous-window read/write on one ring row at absolute
+    position h.  Rows come pre-padded by the assignment-window width
+    (prepare_queues), so both ops are bare dynamic slices — no
+    per-round copy — and never clamp the start (h <= c always: h is
+    head or tail, both bounded by the capacity proof)."""
 
     def read(row, h):
-        padded = jnp.concatenate([row, jnp.full((w,), val.NONE, row.dtype)])
-        return jax.lax.dynamic_slice(padded, (h,), (w,))
+        return jax.lax.dynamic_slice(row, (h,), (w,))
 
     def write(row, wv, h):
-        padded = jnp.concatenate([row, jnp.full((w,), val.NONE, row.dtype)])
-        return jax.lax.dynamic_update_slice(padded, wv, (h,))[:c]
+        return jax.lax.dynamic_update_slice(row, wv, (h,))
 
     return read, write
 
 
-def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
+def _assignable_window(pend, gate, head, tail, chosen_mask, w):
     """First-fit view of the head window: which of the next W queue
     entries are live and gate-satisfied.  Gated entries (the in-order
     client seam, ref multi/main.cpp:398-401: next value only after the
@@ -329,7 +331,7 @@ def _assignable_window(pend, gate, head, tail, chosen_mask, c, w):
     # The window is CONTIGUOUS from head, so reads are padded dynamic
     # slices, not gathers (a [P, W] gather from the [P, C] ring was
     # ~40% of the round's device time at W = 256k).
-    wread, _ = _window_ops(c, w)
+    wread, _ = _window_ops(w)
     qvid = jax.vmap(wread)(pend, head)
     live = ((head[:, None] + offs[None]) < tail[:, None]) & (qvid != val.NONE)
     if chosen_mask is None:
@@ -399,6 +401,14 @@ def build_engine(
         return ~gany(~b)
 
     def round_fn(root: jax.Array, st: SimState) -> SimState:
+        # queue rows must be pre-padded by the window width (see
+        # prepare_queues) so window ops are copy-free dynamic slices
+        for _name in ("pend", "gate"):
+            _w = getattr(st.prop, _name).shape[-1]
+            assert _w == c + cfg.assign_window, (
+                f"{_name} rows are {_w} wide; expected {c} + "
+                f"assign_window {cfg.assign_window} padding"
+            )
         t = st.t
         if axis_name is None:
             off = jnp.int32(0)
@@ -621,7 +631,7 @@ def build_engine(
         else:
             chosen_mask = None  # gate-free run: no gate logic at all
         qvid, ok = _assignable_window(
-            pr.pend, pr.gate, pr.head, pr.tail, chosen_mask, c,
+            pr.pend, pr.gate, pr.head, pr.tail, chosen_mask,
             cfg.assign_window,
         )
         ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1  # [P, W]
@@ -670,7 +680,7 @@ def build_engine(
         # (positions beyond tail hold NONE in qvid and rewrite NONE);
         # then advance head over the leading consumed run
         new_win = jnp.where(take_q, val.NONE, qvid)  # [P, W]
-        _, wwrite = _window_ops(c, w)
+        _, wwrite = _window_ops(w)
         pend = jax.vmap(wwrite)(pr.pend, new_win, pr.head)
         lead_dead = (
             (pr.head[:, None] + jnp.arange(w)[None]) < pr.tail[:, None]
@@ -829,7 +839,7 @@ def build_engine(
             # monotone; nothing ever writes past it), so block
             # positions beyond nreq overwrite NONE with NONE
             # (capacity proof: tail + nreq <= c, see prepare_queues).
-            _, wwrite_r = _window_ops(c, r_cap)
+            _, wwrite_r = _window_ops(r_cap)
             pend = jax.vmap(wwrite_r)(pend, req_block, ptail)
             own2 = jnp.where(take_req | own_done, val.NONE, own_assign)
             return pend, nreq, own2
@@ -1111,8 +1121,14 @@ def prepare_queues(
     below can never overflow."""
     p = len(cfg.proposers)
     c = max(len(wl) for wl in workload) + cfg.n_instances + 8
-    pend = np.full((p, c), int(val.NONE), np.int32)
-    gate = np.full((p, c), int(val.NONE), np.int32)
+    # Rows are over-allocated by the assignment-window width so the
+    # engine's window reads/writes are plain dynamic slices at any
+    # position <= c, with no per-round padding copies; the pad region
+    # [c, c+w) holds NONE invariantly (window writes only ever spill
+    # NONE into it).
+    width = c + cfg.assign_window
+    pend = np.full((p, width), int(val.NONE), np.int32)
+    gate = np.full((p, width), int(val.NONE), np.int32)
     tail = np.zeros((p,), np.int32)
     for pi, wl in enumerate(workload):
         wl = np.asarray(wl, np.int32)

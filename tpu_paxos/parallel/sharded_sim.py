@@ -209,7 +209,8 @@ def prepare_queues_sharded(
     gates: list[np.ndarray] | None,
     n_shards: int,
 ):
-    """Per-shard queue arrays: returns (pend [D, P, C], gate [D, P, C],
+    """Per-shard queue arrays: returns (pend [D, P, C+W], gate
+    [D, P, C+W] — W-padded like ``prepare_queues``'s rows —
     tail [D, P], c) with a uniform capacity C sized by the largest
     shard-local workload plus ``i_local`` requeue headroom (the
     per-shard version of ``prepare_queues``'s capacity proof)."""
@@ -219,8 +220,10 @@ def prepare_queues_sharded(
     c = max(
         max((len(w) for w in wl), default=0) for wl in wls
     ) + i_loc + 8
-    pend = np.full((n_shards, p, c), int(val.NONE), np.int32)
-    gate = np.full((n_shards, p, c), int(val.NONE), np.int32)
+    # rows pre-padded by the window width — see prepare_queues
+    width = c + cfg.assign_window
+    pend = np.full((n_shards, p, width), int(val.NONE), np.int32)
+    gate = np.full((n_shards, p, width), int(val.NONE), np.int32)
     tail = np.zeros((n_shards, p), np.int32)
     for s in range(n_shards):
         for pi, wl in enumerate(wls[s]):
