@@ -27,7 +27,13 @@ Environment knobs: TPU_PAXOS_BENCH_INSTANCES (window size, default
 per timed call, default 16 on TPU / 4 on CPU), TPU_PAXOS_BENCH_FUSED=0
 (force the XLA scan instead of the pallas kernel),
 TPU_PAXOS_BENCH_SHARDED=1 (use every visible device via shard_map —
-BASELINE config 4 shape).
+BASELINE config 4 shape), TPU_PAXOS_BENCH_DCN_HOSTS (2-D multi-host
+mesh for the sharded paths), TPU_PAXOS_BENCH_SIM_INSTANCES /
+TPU_PAXOS_BENCH_SIM_SHARDED_INSTANCES /
+TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES (secondary record sizes),
+TPU_PAXOS_BENCH_SECONDARY=0 / TPU_PAXOS_BENCH_SHARDED_CHILD=0 (skip
+secondary records), TPU_PAXOS_BENCH_PROFILE=<dir> (jax profiler
+trace of the timed window).
 """
 
 from __future__ import annotations
