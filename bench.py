@@ -55,6 +55,33 @@ from tpu_paxos.core import values as val
 
 NORTH_STAR = 10_000_000.0  # instances/sec, BASELINE.json north_star
 
+# A v5e chip moves ~0.82 TB/s through HBM at peak.  Any measurement
+# implying more than this many bytes/sec of state traffic is a timing
+# artifact (the axon device tunnel has produced ~2000x-fast timings
+# when a call was blocked on a scalar only — BENCH_r04's 22B inst/s sim
+# record), not a real number.  Records that trip the guard are withheld
+# and the raw timings printed instead.
+ROOFLINE_BYTES_PER_SEC = 2.0e12
+
+
+def _state_nbytes(state) -> int:
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(state))
+
+
+def _implausible(min_bytes: int, dt: float, n_devices: int = 1) -> str | None:
+    """Return a refusal message if `dt` seconds for at least `min_bytes`
+    of HBM traffic implies impossible bandwidth, else None.  Aggregate
+    HBM bandwidth scales with device count, so the guard does too."""
+    roof = ROOFLINE_BYTES_PER_SEC * max(1, n_devices)
+    bps = min_bytes / max(dt, 1e-12)
+    if bps > roof:
+        return (
+            f"implied {bps:.3g} B/s of state traffic exceeds the "
+            f"{roof:.2g} B/s ({n_devices}-device) roofline guard; "
+            "timing artifact — record withheld"
+        )
+    return None
+
 
 def _total(counts) -> int:
     """Host-side sum of per-window chosen counts (both window paths
@@ -62,6 +89,16 @@ def _total(counts) -> int:
     import numpy as np
 
     return int(np.asarray(counts, dtype=np.int64).sum())
+
+
+def _check_total(counts, expected: int) -> None:
+    """Host-sync + correctness check in one: transfers the counts (the
+    blocking barrier inside every timed window) and raises — not
+    asserts, which `python -O` would strip along with the sync — on a
+    wrong chosen count."""
+    n = _total(counts)
+    if n != expected:
+        raise RuntimeError(f"window chose {n} instances, expected {expected}")
 
 
 def _steady_state_windows(
@@ -208,27 +245,75 @@ def bench_sim_record() -> dict:
 
         return jax.lax.while_loop(cond, body, st)
 
-    final = go(root, state0)
-    final.done.block_until_ready()  # compile + first run
-    t0 = time.perf_counter()
-    final = go(root, state0)
-    final.done.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    return _sim_record(
-        final,
-        dt,
-        i,
-        {
-            "n_nodes": 5,
-            "n_instances": i,
-            "proposers": 2,
-            "faults": "drop500/dup1000/delay0-2",
-            "sharded": False,
-            "devices": 1,
-            "platform": jax.devices()[0].platform,
-        },
+    config = {
+        "n_nodes": 5,
+        "n_instances": i,
+        "proposers": 2,
+        "faults": "drop500/dup1000/delay0-2",
+        "sharded": False,
+        "devices": 1,
+        "platform": jax.devices()[0].platform,
+    }
+    return _timed_sim_runs(
+        go, lambda k: prng.root_key(cfg.seed + k), state0, i, config
     )
+
+
+def _timed_sim_runs(go, root_for, state0, n_instances: int, config: dict) -> dict:
+    """Artifact-proof timing for a general-engine run (VERDICT r4 #1):
+    every timed call runs a genuinely different computation (fresh prng
+    root — BENCH_r04's 22B inst/s artifact came from re-invoking with
+    identical args), the clock stops only after a chosen-count scalar
+    computed from the full per-instance result inside the same jitted
+    call has crossed to the host, the median of three runs is the
+    record, and a roofline guard withholds any physically impossible
+    number (raw timings are reported either way).  The full arrays for
+    the rounds-to-chosen stats transfer after the clock stops: the
+    axon tunnel moves ~14 MB/s, so an in-clock 32 MB transfer would
+    bill ~2.3 s of host I/O to the engine."""
+    import types
+
+    @jax.jit
+    def go_counted(root, st):
+        f = go(root, st)
+        return f, jnp.sum(f.met.chosen_vid != val.NONE)
+
+    # Warm with a root OUTSIDE the timed range — a timed call with
+    # byte-identical args to the warmup is the exact artifact
+    # precondition this function exists to avoid.
+    final, nc = go_counted(root_for(3), state0)
+    warm_count = int(nc)  # compile + warm run, materialized through the count
+    final = None
+    runs = []
+    for k in range(3):
+        t0 = time.perf_counter()
+        f, nc = go_counted(root_for(k), state0)
+        nc = int(nc)  # blocks on a value derived from every instance
+        dtk = time.perf_counter() - t0
+        # Keep only what the record needs; the full SimState (several
+        # GiB at bench sizes) frees before the next run.
+        runs.append(
+            (dtk, types.SimpleNamespace(met=f.met, t=int(f.t), done=bool(f.done)))
+        )
+        del f
+        if nc != warm_count:  # not assert: -O must not strip the sync/check
+            raise RuntimeError(
+                f"seed {k} chose {nc} instances, warmup chose {warm_count}"
+            )
+    dts = sorted(dt for dt, _ in runs)
+    dt, final = min(runs, key=lambda r: abs(r[0] - dts[1]))  # the median run
+    raw = [round(x, 4) for x in dts]
+    # Each engine round must stream the whole carried state through HBM
+    # at least once — the floor for the bandwidth the timing implies.
+    refusal = _implausible(
+        _state_nbytes(state0) * int(final.t), dt, config.get("devices", 1)
+    )
+    if refusal is not None:
+        return {"engine": "sim", "error": refusal, "raw_timings_s": raw,
+                "config": config}
+    rec = _sim_record(final, dt, n_instances, config)
+    rec["raw_timings_s"] = raw
+    return rec
 
 
 def bench_sharded_child() -> list[dict]:
@@ -237,6 +322,7 @@ def bench_sharded_child() -> list[dict]:
     BASELINE config 4 shape, honestly labeled as virtual devices."""
     from tpu_paxos.config import FaultConfig, SimConfig
     from tpu_paxos.parallel import sharded_sim
+    from tpu_paxos.utils import prng
 
     n_dev = len(jax.devices())
     platform = f"{jax.devices()[0].platform}-virtual-{n_dev}"
@@ -244,38 +330,58 @@ def bench_sharded_child() -> list[dict]:
 
     # fast path, 7 nodes, 100M instances over the mesh — BASELINE
     # config 4 at its literal size (the virtual mesh holds the full
-    # [7, 100M] state; ~10 GiB host RAM)
+    # [7, 100M] state; ~10 GiB host RAM).  Hosts without that much
+    # free memory get the 1M size instead of an OOM, unless the env
+    # knob asks for a size explicitly.
     n_nodes, reps = 7, 4
-    n_fast = int(
-        os.environ.get("TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES", 100_000_000)
-    )
+    n_fast_env = os.environ.get("TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES")
+    if n_fast_env is not None:
+        n_fast = int(n_fast_env)
+    else:
+        n_fast = 100_000_000
+        avail = _available_ram_bytes()
+        if avail is not None and avail < 14 << 30:
+            print(
+                f"only {avail >> 30} GiB RAM available; sharded-fast "
+                "record falls back to 1M instances (set "
+                "TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES to override)",
+                file=sys.stderr,
+            )
+            n_fast = 1_000_000
     mesh, step, state, vids0, n_inst = _sharded_fast_setup(
         n_nodes, n_fast, reps, donate=True
     )
     state2, total = step(state, vids0)
-    total.block_until_ready()
-    t0 = time.perf_counter()
-    _, total = step(state2, vids0)
-    total.block_until_ready()
-    dt = time.perf_counter() - t0
-    assert _total(total) == n_inst * reps
-    records.append(
-        {
-            "engine": "fast",
-            "baseline_config": 4,
-            "metric": "paxos_instances_per_sec_to_chosen",
-            "value": round(n_inst * reps / dt, 1),
-            "unit": "instances/sec",
-            "config": {
-                "n_nodes": n_nodes,
-                "n_instances_per_window": n_inst,
-                "windows": reps,
-                "sharded": True,
-                "devices": n_dev,
-                "platform": platform,
-            },
-        }
-    )
+    _check_total(total, n_inst * reps)  # warmup, fully materialized
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state2, total = step(state2, vids0)
+        _check_total(total, n_inst * reps)
+        dts.append(time.perf_counter() - t0)
+    dt = sorted(dts)[1]
+    fast_rec = {
+        "engine": "fast",
+        "baseline_config": 4,
+        "metric": "paxos_instances_per_sec_to_chosen",
+        "value": round(n_inst * reps / dt, 1),
+        "unit": "instances/sec",
+        "raw_timings_s": [round(x, 4) for x in sorted(dts)],
+        "config": {
+            "n_nodes": n_nodes,
+            "n_instances_per_window": n_inst,
+            "windows": reps,
+            "sharded": True,
+            "devices": n_dev,
+            "platform": platform,
+        },
+    }
+    refusal = _implausible(_state_nbytes(state2) * reps, dt, n_dev)
+    if refusal is not None:
+        fast_rec = {"engine": "fast", "error": refusal,
+                    "raw_timings_s": fast_rec["raw_timings_s"],
+                    "config": fast_rec["config"]}
+    records.append(fast_rec)
     del step, state, state2, vids0, total
 
     # same engine on the 2-D multi-host (dcn x ici) mesh — the
@@ -290,12 +396,14 @@ def bench_sharded_child() -> list[dict]:
                 n_nodes, min(n_fast, 10_000_000), reps, donate=True
             )
             st2b, total = step2(st2, v2)
-            total.block_until_ready()
-            t0 = time.perf_counter()
-            _, total = step2(st2b, v2)
-            total.block_until_ready()
-            dt = time.perf_counter() - t0
-            assert _total(total) == n_inst2 * reps
+            _check_total(total, n_inst2 * reps)  # warmup, materialized
+            dts2 = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                st2b, total = step2(st2b, v2)
+                _check_total(total, n_inst2 * reps)
+                dts2.append(time.perf_counter() - t0)
+            dt = sorted(dts2)[1]
             records.append(
                 {
                     "engine": "fast",
@@ -303,6 +411,7 @@ def bench_sharded_child() -> list[dict]:
                     "metric": "paxos_instances_per_sec_to_chosen",
                     "value": round(n_inst2 * reps / dt, 1),
                     "unit": "instances/sec",
+                    "raw_timings_s": [round(x, 4) for x in sorted(dts2)],
                     "config": {
                         "n_nodes": n_nodes,
                         "n_instances_per_window": n_inst2,
@@ -329,30 +438,43 @@ def bench_sharded_child() -> list[dict]:
         max_rounds=20_000,
         faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
     )
-    fn, root, st0, _ = sharded_sim.build_runner(cfg, mesh)
-    final = fn(root, st0)
-    final.done.block_until_ready()
-    t0 = time.perf_counter()
-    final = fn(root, st0)
-    final.done.block_until_ready()
-    dt = time.perf_counter() - t0
-    records.append(
-        _sim_record(
-            final,
-            dt,
-            i,
-            {
-                "n_nodes": 7,
-                "n_instances": i,
-                "proposers": 2,
-                "faults": "drop500/dup1000/delay0-2",
-                "sharded": True,
-                "devices": n_dev,
-                "platform": platform,
-            },
+    fn, _root, st0, _ = sharded_sim.build_runner(cfg, mesh)
+    try:
+        records.append(
+            _timed_sim_runs(
+                fn,
+                lambda k: prng.root_key(cfg.seed + k),
+                st0,
+                i,
+                {
+                    "n_nodes": 7,
+                    "n_instances": i,
+                    "proposers": 2,
+                    "faults": "drop500/dup1000/delay0-2",
+                    "sharded": True,
+                    "devices": n_dev,
+                    "platform": platform,
+                },
+            )
         )
-    )
+    except Exception as e:
+        # the fast-path records above are already measured; never lose
+        # them to a sim failure
+        records.append({"engine": "sim", "error": str(e)[:500]})
     return records
+
+
+def _available_ram_bytes() -> int | None:
+    """MemAvailable from /proc/meminfo, or None where that can't be
+    read (non-Linux) — callers treat unknown as 'enough'."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return None
 
 
 def _sharded_records_via_subprocess(n_devices: int = 8) -> list[dict]:
@@ -438,15 +560,21 @@ def main() -> None:
     else:
         state, vids0, step = _scan_setup()
 
-    # Warmup / compile.  If the pallas path fails on this backend, fall
-    # back to the XLA scan rather than losing the bench run (both paths
-    # share the vid-space guard, so a config error re-raises there).
+    # Warmup / compile.  If the pallas path fails to compile or run on
+    # this backend, fall back to the XLA scan rather than losing the
+    # bench run — but config errors (ValueError: bad window size, vid
+    # space overflow) re-raise, so a typo can't silently demote the
+    # headline to the ~3.6x-slower scan.
+    fallback_reason = None
     try:
         state2, total = step(state, vids0)
         total.block_until_ready()
+    except ValueError:
+        raise
     except Exception as e:
         if not fused:
             raise
+        fallback_reason = repr(e)[:300]
         print(
             f"pallas fused window failed ({e!r}); falling back to XLA scan",
             file=sys.stderr,
@@ -456,7 +584,8 @@ def main() -> None:
         state, vids0, step = _scan_setup()
         state2, total = step(state, vids0)
         total.block_until_ready()
-    assert _total(total) == n_inst * reps, f"warmup chose {_total(total)}"
+    _check_total(total, n_inst * reps)  # warmup correctness
+    headline_state_nbytes = _state_nbytes(state2)
 
     # Optional profiler capture of the timed window
     # (TPU_PAXOS_BENCH_PROFILE=<dir>; view with tensorboard/xprof).
@@ -478,9 +607,24 @@ def main() -> None:
             state2, total = step(state2, vids0)
             total.block_until_ready()
             dts.append(time.perf_counter() - t0)
-            n_chosen = _total(total)
-            assert n_chosen == n_inst * reps, f"bench chose {n_chosen}"
+            _check_total(total, n_inst * reps)
     dt = sorted(dts)[1]
+    # Roofline sanity: each window streams the full state through HBM
+    # at least once.  If the median implies impossible bandwidth the
+    # timer is lying — fall back to the slowest timing, and if even
+    # that is impossible, clamp dt to the roofline floor so the
+    # published number can never exceed what the hardware can do.
+    n_dev = len(jax.devices()) if use_sharded else 1
+    min_bytes = headline_state_nbytes * reps
+    roofline_note = None
+    refusal = _implausible(min_bytes, dt, n_dev)
+    if refusal is not None:
+        dt = sorted(dts)[-1]
+        roofline_note = refusal + "; value recomputed from slowest timing"
+        if _implausible(min_bytes, dt, n_dev) is not None:
+            dt = min_bytes / (ROOFLINE_BYTES_PER_SEC * max(1, n_dev))
+            roofline_note = refusal + "; value clamped to the roofline"
+        print(f"headline {refusal}; raw timings {dts}", file=sys.stderr)
     rate = n_inst * reps / dt
     # Release the headline run's device state (~8 GiB on TPU) before
     # the secondary engines run on the same chip.
@@ -513,12 +657,23 @@ def main() -> None:
                 "value": round(rate, 1),
                 "unit": "instances/sec",
                 "vs_baseline": round(rate / NORTH_STAR, 3),
+                "raw_timings_s": [round(x, 4) for x in sorted(dts)],
                 "config": {
                     "n_nodes": n_nodes,
                     "n_instances_per_window": n_inst,
                     "windows": reps,
                     "sharded": bool(use_sharded and len(jax.devices()) > 1),
                     "fused_kernel": fused,
+                    **(
+                        {"fallback_reason": fallback_reason}
+                        if fallback_reason
+                        else {}
+                    ),
+                    **(
+                        {"roofline_note": roofline_note}
+                        if roofline_note
+                        else {}
+                    ),
                     "devices": len(jax.devices()),
                     "platform": jax.devices()[0].platform,
                 },
