@@ -139,6 +139,11 @@ class ProposerState(NamedTuple):
     commit_acked: jax.Array  # [P, A, I] bool
     commit_deadline: jax.Array  # [P] int32
     stall: jax.Array  # [P] int32 rounds spent idle while the log has holes
+    commit_wait: jax.Array  # [P] bool: any committed instance not yet
+    #     acked by every live node — a cached reduction of the
+    #     commit_acked cube, refreshed only on the rounds that can
+    #     change it (commit replies, new commits, crashes), so the
+    #     resend/idle logic never pays a [P, A, I] pass on quiet rounds
 
 
 class Metrics(NamedTuple):
@@ -259,6 +264,7 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
             commit_acked=jnp.zeros((p, a, i), jnp.bool_),
             commit_deadline=jnp.zeros((p,), jnp.int32),
             stall=jnp.zeros((p,), jnp.int32),
+            commit_wait=jnp.zeros((p,), jnp.bool_),
         ),
         net=netm.init_buffers(s, p, a),
         met=Metrics(
@@ -272,18 +278,19 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
     )
 
 
-def _select_by_argmax(values_pi, cand_pai):
-    """values [P, I], cand [P, A, I] masked ballots: per (a, i) pick
-    the value at the max-ballot candidate (NONE when none).
-
-    Implemented as two fused masked-max passes, NOT argmax + gather —
-    the gather lowering dominates round wall time on TPU.  Exact
-    because ballots are unique per proposer ((count << 16) | node), so
-    a ballot tie across the P axis is impossible."""
-    best_b = jnp.max(cand_pai, axis=0)  # [A, I]
-    sel = (cand_pai == best_b[None]) & (cand_pai != bal.NONE)
-    v = jnp.max(jnp.where(sel, values_pi[:, None, :], _NEG), axis=0)
-    return jnp.where(best_b != bal.NONE, v, val.NONE), best_b
+def _gate_satisfied(g, chosen_mask):
+    """Gate test shared by _assignable_window and the engine's gated
+    assignment branch: an entry is proposable when ungated or its gate
+    vid is in the chosen-membership bitmap; gates on out-of-workload
+    vids never satisfy (the semantics of gating on a value that is
+    never proposed)."""
+    v_cap = chosen_mask.shape[0]
+    g_chosen = (
+        chosen_mask[jnp.clip(g, 0, v_cap - 1)]
+        & (g != val.NONE)
+        & (g < v_cap)
+    )
+    return (g == val.NONE) | g_chosen
 
 
 def _window_ops(w: int):
@@ -337,13 +344,7 @@ def _assignable_window(pend, gate, head, tail, chosen_mask, w):
     if chosen_mask is None:
         return qvid, live
     g = jax.vmap(wread)(gate, head)  # [P, W]
-    v_cap = chosen_mask.shape[0]
-    g_chosen = (
-        chosen_mask[jnp.clip(g, 0, v_cap - 1)]
-        & (g != val.NONE)
-        & (g < v_cap)  # gates on out-of-workload vids never satisfy
-    )
-    ok = live & ((g == val.NONE) | g_chosen)
+    ok = live & _gate_satisfied(g, chosen_mask)
     return qvid, ok
 
 
@@ -457,24 +458,58 @@ def build_engine(
         max_seen = jnp.maximum(max_seen, jnp.max(apres, axis=0))
         elig = has_acc & (abal[:, None] >= promised)  # >=, ref :1366
         rej_acc = has_acc & ~elig
-        w_has = abat != val.NONE  # [P, I]
-        is_comm = learned != val.NONE  # [A, I]
-        # Per-instance ack: store-or-match (see module docstring for
-        # the deviation from the reference's blanket batch ack).
-        ack = (
-            elig[:, :, None]
-            & w_has[:, None, :]
-            & jnp.where(
-                is_comm[None],
-                abat[:, None, :] == learned[None],
-                abal[:, None, None] >= acc.acc_ballot[None],
+        # The [P, A, I] store cube exists only on rounds where an
+        # eligible accept actually arrives (roughly a third of rounds
+        # at the reference fault rates) — cond-gated on a GLOBAL
+        # predicate so every shard branches identically.  When the
+        # branch is skipped the acceptor arrays pass through
+        # untouched, exactly what the all-false cube would produce.
+        any_acc_arr = gany(jnp.any(elig))
+
+        def _store_accepts(acc_ballot, acc_vid):
+            # Per-instance ack: store-or-match (see module docstring
+            # for the deviation from the reference's blanket batch
+            # ack).  The proposer axis is UNROLLED (P is a small
+            # static constant) into a running elementwise masked-max
+            # over [A, I] — a single fused HBM pass — instead of
+            # materializing the [P, A, I] candidate cube and reducing
+            # it (the cube's ~4 intermediate passes were the single
+            # largest block in the round profile).  Exact because
+            # ballots are unique per proposer ((count << 16) | node),
+            # so the running max never ties across P.
+            is_comm = learned != val.NONE  # [A, I]
+            best_b = jnp.full_like(acc_ballot, bal.NONE)
+            best_v = jnp.full_like(acc_vid, val.NONE)
+            for pi in range(p):
+                batp = abat[pi]  # [I]
+                ackp = (
+                    elig[pi][:, None]
+                    & (batp != val.NONE)[None, :]
+                    & jnp.where(
+                        is_comm,
+                        batp[None, :] == learned,
+                        abal[pi] >= acc_ballot,
+                    )
+                )  # [A, I]
+                candp = jnp.where(ackp & ~is_comm, abal[pi], bal.NONE)
+                take = candp > best_b
+                best_b = jnp.where(take, candp, best_b)
+                best_v = jnp.where(
+                    take, jnp.broadcast_to(batp[None, :], best_v.shape), best_v
+                )
+            do_store = best_b != bal.NONE
+            return (
+                jnp.where(do_store, best_b, acc_ballot),
+                jnp.where(do_store, best_v, acc_vid),
             )
-        )  # [P, A, I]
-        cand = jnp.where(ack & ~is_comm[None], abal[:, None, None], bal.NONE)
-        store_v, store_b = _select_by_argmax(abat, cand)
-        do_store = store_b != bal.NONE
-        acc_ballot = jnp.where(do_store, store_b, acc.acc_ballot)
-        acc_vid = jnp.where(do_store, store_v, acc.acc_vid)
+
+        acc_ballot, acc_vid = jax.lax.cond(
+            any_acc_arr,
+            _store_accepts,
+            lambda b, v: (b, v),
+            acc.acc_ballot,
+            acc.acc_vid,
+        )
 
         # COMMIT arrivals -> learner state (ref OnCommit,
         # multi/paxos.cpp:1494-1518).  Content is the sender's
@@ -482,10 +517,26 @@ def build_engine(
         # send-time batch — a legal later send).
         cpres = ar.com_pres & alive_a[None, :]  # [P, A]
         cbat = st.prop.commit_vid  # [P, I]
-        inc = cpres[:, :, None] & (cbat != val.NONE)[:, None, :]  # [P, A, I]
-        has_inc = jnp.any(inc, axis=0)  # [A, I]
-        inc_v = jnp.max(jnp.where(inc, cbat[:, None, :], _NEG), axis=0)
-        learned = jnp.where(has_inc & (learned == val.NONE), inc_v, learned)
+        # Same gating pattern as the accept store: the [P, A, I]
+        # delivery cube only on rounds a commit actually arrives.
+        any_com_arr = gany(jnp.any(cpres))
+
+        def _learn_commits(learned):
+            # Unrolled over P like _store_accepts: a running
+            # elementwise max over [A, I], no [P, A, I] cube.
+            inc_v = jnp.full_like(learned, _NEG)
+            for pi in range(p):
+                incp = cpres[pi][:, None] & (cbat[pi] != val.NONE)[None, :]
+                inc_v = jnp.maximum(
+                    inc_v, jnp.where(incp, cbat[pi][None, :], _NEG)
+                )
+            return jnp.where(
+                (inc_v != _NEG) & (learned == val.NONE), inc_v, learned
+            )
+
+        learned = jax.lax.cond(
+            any_com_arr, _learn_commits, lambda l: l, learned
+        )
 
         acc = AcceptorState(promised, max_seen, acc_ballot, acc_vid)
 
@@ -556,45 +607,62 @@ def build_engine(
         now_prepared = (
             (pr.mode == PREPARING) & (n_prom >= quorum) & prop_alive
         )
-        committed_p = learned[pn] != val.NONE  # [P, I]
-        use_adopt = ~committed_p & (adopted_b != bal.NONE)
-        covered0 = committed_p | use_adopt
-        # Hole-fill frontier: local while this shard still has values
-        # to place (their space below the global frontier is capacity,
-        # not holes); extended to the global frontier only once EVERY
-        # proposer's queue on this shard is drained — the shard's
-        # instance space is shared, so one drained proposer must not
-        # noop-fill space another proposer's queued values need, and
-        # all-drained also implies no future conflict requeue can ever
-        # re-open a queue here (conflicts need a live own_assign).
-        # Then each shard's region closes with no-ops and global
-        # contiguity (the apply frontier, quiescence) is reached.
-        # Unsharded: gmax is identity — hi is the usual frontier.
-        hi_loc = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)  # [P]
-        # crashed proposers are excused (their queues are dead, exactly
-        # as q_empty excuses them) or the shard could never close
-        drained = (
-            (pr.head >= pr.tail)
-            & jnp.all(pr.own_assign == val.NONE, axis=1)
-        ) | ~prop_alive  # [P] this shard's queue fully placed
-        hi = jnp.where(jnp.all(drained), gmax(hi_loc), hi_loc)
-        below = idx[None] <= hi[:, None]
-        noop_fill = below & ~covered0
-        own_has = pr.own_assign != val.NONE
-        use_own = ~below & own_has
-        batch0 = jnp.where(
-            use_adopt,
-            adopted_v,
-            jnp.where(
-                noop_fill,
-                val.noop_vid(idx[None], pn[:, None], i_cap),
-                jnp.where(use_own, pr.own_assign, val.NONE),
-            ),
+        # Batch assembly is several [P, I] passes plus a [P, A, I]
+        # clear, and a proposer reaches phase-1 quorum only a handful
+        # of times per run — the whole skeleton is cond-gated (global
+        # predicate: the gmax inside must branch identically on every
+        # shard).
+        any_p1 = gany(jnp.any(now_prepared))
+
+        def _build_batches(cur_batch, acks):
+            committed_p = learned[pn] != val.NONE  # [P, I]
+            use_adopt = ~committed_p & (adopted_b != bal.NONE)
+            covered0 = committed_p | use_adopt
+            # Hole-fill frontier: local while this shard still has
+            # values to place (their space below the global frontier is
+            # capacity, not holes); extended to the global frontier
+            # only once EVERY proposer's queue on this shard is drained
+            # — the shard's instance space is shared, so one drained
+            # proposer must not noop-fill space another proposer's
+            # queued values need, and all-drained also implies no
+            # future conflict requeue can ever re-open a queue here
+            # (conflicts need a live own_assign).  Then each shard's
+            # region closes with no-ops and global contiguity (the
+            # apply frontier, quiescence) is reached.  Unsharded: gmax
+            # is identity — hi is the usual frontier.
+            hi_loc = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)
+            # crashed proposers are excused (their queues are dead,
+            # exactly as q_empty excuses them) or the shard could
+            # never close
+            drained = (
+                (pr.head >= pr.tail)
+                & jnp.all(pr.own_assign == val.NONE, axis=1)
+            ) | ~prop_alive  # [P] this shard's queue fully placed
+            hi = jnp.where(jnp.all(drained), gmax(hi_loc), hi_loc)
+            below = idx[None] <= hi[:, None]
+            noop_fill = below & ~covered0
+            own_has = pr.own_assign != val.NONE
+            use_own = ~below & own_has
+            batch0 = jnp.where(
+                use_adopt,
+                adopted_v,
+                jnp.where(
+                    noop_fill,
+                    val.noop_vid(idx[None], pn[:, None], i_cap),
+                    jnp.where(use_own, pr.own_assign, val.NONE),
+                ),
+            )
+            batch0 = jnp.where(committed_p, val.NONE, batch0)
+            return (
+                jnp.where(now_prepared[:, None], batch0, cur_batch),
+                jnp.where(now_prepared[:, None, None], False, acks),
+            )
+
+        cur_batch, acks = jax.lax.cond(
+            any_p1, _build_batches, lambda cb, ak: (cb, ak),
+            pr.cur_batch, pr.acks,
         )
-        batch0 = jnp.where(committed_p, val.NONE, batch0)
         mode = jnp.where(now_prepared, PREPARED, pr.mode)
-        cur_batch = jnp.where(now_prepared[:, None], batch0, pr.cur_batch)
-        acks = jnp.where(now_prepared[:, None, None], False, pr.acks)
         acc_retries = jnp.where(
             now_prepared, pc.accept_retry_count, pr.acc_retries
         )
@@ -606,87 +674,134 @@ def build_engine(
         # queue entries (first-fit) onto the lowest free instances in
         # the open tail (ref unproposed_instance_ids_.Next).
         can_assign = (mode == PREPARED) & prop_alive
-        activity = (
-            committed_p | (cur_batch != val.NONE) | (pr.own_assign != val.NONE)
-        )
-        # Assignment frontier is shard-LOCAL: each shard first-fits its
-        # own queue onto its own lowest free instances (placement
-        # differs from the unsharded engine; safety and the chosen
-        # multiset do not — see parallel/sharded_sim.py).
-        # Free instances are by construction the CONTIGUOUS suffix
-        # (hi2, end-of-shard), so free ranks are closed-form arithmetic
-        # and placement is a dynamic slice — no [P, I] cumsum and no
-        # 1M-element gather (which cost ~40% of the round's wall time).
-        hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)  # [P] global
-        hi2l = jnp.maximum(hi2, off - 1)  # clamp sentinel into this shard
-        free = idx[None] > hi2l[:, None]  # [P, I] contiguous suffix
-        free_rank = idx[None] - hi2l[:, None] - 1  # [P, I]
-        n_free = (off + i_loc - 1) - hi2l  # [P]
-        if vid_cap:
-            # chosen-vid membership bitmap for the gate test (only
-            # True scatters; invalid indices routed out of range)
-            chosen_mask = jnp.zeros((vid_cap,), jnp.bool_).at[
-                jnp.where(st.met.chosen_vid >= 0, st.met.chosen_vid, vid_cap)
-            ].set(True, mode="drop")
-        else:
-            chosen_mask = None  # gate-free run: no gate logic at all
-        qvid, ok = _assignable_window(
-            pr.pend, pr.gate, pr.head, pr.tail, chosen_mask,
-            cfg.assign_window,
-        )
-        ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1  # [P, W]
-        k = jnp.minimum(jnp.sum(ok, axis=1), n_free)
-        k = jnp.where(can_assign, k, 0)
-        take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
         w = cfg.assign_window
-        prow = jnp.arange(p)[:, None]
-        takev = free & (free_rank < k[:, None])  # instances filled
-        start = jnp.clip(hi2l + 1 - off, 0, i_loc)
-        # Rounds with nothing to assign (most of a long run) skip the
-        # rank scatter entirely; the predicate is global so every
-        # shard branches identically.
-        any_assign = gany(jnp.any(k > 0))
+        # The whole assignment — gate bitmap, [P, I] frontier scan,
+        # rank scatter, queue write-back — runs only on rounds where a
+        # PREPARED proposer actually has a live window entry.  The
+        # predicate reads just the O(W) window view (gate satisfaction
+        # is evaluated inside the branch: a gate-blocked window pays
+        # the branch while it waits, an empty queue pays nothing).
+        qvid, live = _assignable_window(
+            pr.pend, pr.gate, pr.head, pr.tail, None, w
+        )
+        any_window = gany(jnp.any(live & can_assign[:, None]))
 
-        def _compute_newv(qvid_, take_q_, start_):
-            # vid of the r-th taken entry by rank: an O(W) rank
-            # scatter (taken entries have distinct ranks; untaken
-            # slots are routed out of range and dropped) — an equality
-            # one-hot here would cost O(W^2) and cap the window size
-            rank_pos = jnp.where(take_q_, ok_rank, w)  # [P, W]
-            by_rank = jnp.full((p, w), val.NONE, jnp.int32).at[
-                prow, rank_pos
-            ].set(qvid_, mode="drop")
+        def _assign(cur_batch, own_assign, pend, head):
+            if vid_cap:
+                # chosen-vid membership bitmap for the gate test (only
+                # True scatters; invalid indices routed out of range)
+                chosen_mask = jnp.zeros((vid_cap,), jnp.bool_).at[
+                    jnp.where(
+                        st.met.chosen_vid >= 0, st.met.chosen_vid, vid_cap
+                    )
+                ].set(True, mode="drop")
+                wread, _ = _window_ops(w)
+                g = jax.vmap(wread)(pr.gate, head)  # [P, W]
+                ok = live & _gate_satisfied(g, chosen_mask)
+            else:
+                ok = live  # gate-free run: no gate logic at all
+            activity = (
+                (learned[pn] != val.NONE)
+                | (cur_batch != val.NONE)
+                | (own_assign != val.NONE)
+            )
+            # Assignment frontier is shard-LOCAL: each shard first-fits
+            # its own queue onto its own lowest free instances
+            # (placement differs from the unsharded engine; safety and
+            # the chosen multiset do not — see parallel/sharded_sim.py).
+            # Free instances are by construction the CONTIGUOUS suffix
+            # (hi2, end-of-shard), so free ranks are closed-form
+            # arithmetic and placement is a dynamic slice — no [P, I]
+            # cumsum and no 1M-element gather (which cost ~40% of the
+            # round's wall time).
+            hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)
+            hi2l = jnp.maximum(hi2, off - 1)  # clamp sentinel into shard
+            free = idx[None] > hi2l[:, None]  # [P, I] contiguous suffix
+            free_rank = idx[None] - hi2l[:, None] - 1  # [P, I]
+            n_free = (off + i_loc - 1) - hi2l  # [P]
+            ok_rank = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1
+            k = jnp.minimum(jnp.sum(ok, axis=1), n_free)
+            k = jnp.where(can_assign, k, 0)
+            take_q = ok & (ok_rank < k[:, None])  # queue entries consumed
+            prow = jnp.arange(p)[:, None]
+            takev = free & (free_rank < k[:, None])  # instances filled
+            start = jnp.clip(hi2l + 1 - off, 0, i_loc)
+            # Rounds with a live-but-unassignable window (gated, or no
+            # free instances) still skip the rank scatter; global
+            # predicate as above.
+            any_assign = gany(jnp.any(k > 0))
 
-            # place the ranked vids at the contiguous free window: a
-            # padded dynamic-slice write (start is always in
-            # [0, i_loc], so nothing clamps or shifts), truncated back
-            # to shard size
-            def _place(br, h):
-                buf = jnp.full((i_loc + w,), val.NONE, jnp.int32)
-                return jax.lax.dynamic_update_slice(buf, br, (h,))[:i_loc]
+            def _compute_newv(qvid_, take_q_, start_):
+                # vid of the r-th taken entry by rank.  In the common
+                # case (ungated queues, fully-drained windows) the
+                # taken entries are a contiguous PREFIX of the window,
+                # so rank r is position r and the ranking is a pure
+                # elementwise select.  Otherwise: an O(W) rank scatter
+                # (taken entries have distinct ranks; untaken slots
+                # are routed out of range and dropped — an equality
+                # one-hot would cost O(W^2) and cap the window size).
+                # The scatter serializes on TPU (~10 ms at W = 1M),
+                # which is why the prefix fast path is worth a cond.
+                offs_w = jnp.arange(w, dtype=jnp.int32)[None]
+                is_prefix = gall(jnp.all(take_q_ == (offs_w < k[:, None])))
 
-            return jax.vmap(_place)(by_rank, start_)
+                def _by_rank_prefix(qvid_, take_q_):
+                    return jnp.where(take_q_, qvid_, val.NONE)
 
-        newv = jax.lax.cond(
-            any_assign,
-            _compute_newv,
-            lambda *_: jnp.full((p, i_loc), val.NONE, jnp.int32),
-            qvid, take_q, start,
-        )  # [P, I]
-        cur_batch = jnp.where(takev, newv, cur_batch)
-        own_assign = jnp.where(takev, newv, pr.own_assign)
-        # consume taken entries in place: the window is contiguous from
-        # head, so this is a masked window write-back, not a scatter
-        # (positions beyond tail hold NONE in qvid and rewrite NONE);
-        # then advance head over the leading consumed run
-        new_win = jnp.where(take_q, val.NONE, qvid)  # [P, W]
-        _, wwrite = _window_ops(w)
-        pend = jax.vmap(wwrite)(pr.pend, new_win, pr.head)
-        lead_dead = (
-            (pr.head[:, None] + jnp.arange(w)[None]) < pr.tail[:, None]
-        ) & (new_win == val.NONE)
-        head = pr.head + jnp.sum(
-            jnp.cumprod(lead_dead.astype(jnp.int32), axis=1), axis=1
+                def _by_rank_scatter(qvid_, take_q_):
+                    rank_pos = jnp.where(take_q_, ok_rank, w)  # [P, W]
+                    return jnp.full((p, w), val.NONE, jnp.int32).at[
+                        prow, rank_pos
+                    ].set(qvid_, mode="drop")
+
+                by_rank = jax.lax.cond(
+                    is_prefix, _by_rank_prefix, _by_rank_scatter,
+                    qvid_, take_q_,
+                )
+
+                # place the ranked vids at the contiguous free window:
+                # a padded dynamic-slice write (start is always in
+                # [0, i_loc], so nothing clamps or shifts), truncated
+                # back to shard size
+                def _place(br, h):
+                    buf = jnp.full((i_loc + w,), val.NONE, jnp.int32)
+                    return jax.lax.dynamic_update_slice(buf, br, (h,))[
+                        :i_loc
+                    ]
+
+                return jax.vmap(_place)(by_rank, start_)
+
+            newv = jax.lax.cond(
+                any_assign,
+                _compute_newv,
+                lambda *_: jnp.full((p, i_loc), val.NONE, jnp.int32),
+                qvid, take_q, start,
+            )  # [P, I]
+            cur_batch = jnp.where(takev, newv, cur_batch)
+            own_assign = jnp.where(takev, newv, own_assign)
+            # consume taken entries in place: the window is contiguous
+            # from head, so this is a masked window write-back, not a
+            # scatter (positions beyond tail hold NONE in qvid and
+            # rewrite NONE); then advance head over the leading
+            # consumed run
+            new_win = jnp.where(take_q, val.NONE, qvid)  # [P, W]
+            _, wwrite = _window_ops(w)
+            pend = jax.vmap(wwrite)(pend, new_win, head)
+            lead_dead = (
+                (head[:, None] + jnp.arange(w)[None]) < pr.tail[:, None]
+            ) & (new_win == val.NONE)
+            head = head + jnp.sum(
+                jnp.cumprod(lead_dead.astype(jnp.int32), axis=1), axis=1
+            )
+            return cur_batch, own_assign, pend, head, k
+
+        cur_batch, own_assign, pend, head, k = jax.lax.cond(
+            any_window,
+            _assign,
+            lambda cb, oa, pe, hd: (
+                cb, oa, pe, hd, jnp.zeros((p,), jnp.int32),
+            ),
+            cur_batch, pr.own_assign, pr.pend, pr.head,
         )
         added = gany(k > 0)  # any shard assigned -> (re)send accepts
 
@@ -697,30 +812,55 @@ def build_engine(
         # higher-ballot overwrites in between are reply drops — legal.
         aecho = jnp.where(alive_a[:, None], ar.acc_echo, bal.NONE)  # [A, P]
         amatch = (aecho == pr.ballot[None, :]) & (mode[None, :] == PREPARED)
-        hold = (acc.acc_vid[None] == cur_batch[:, None, :]) & (
-            acc.acc_ballot[None] == pr.ballot[:, None, None]
-        )  # [P, A, I]
-        comm = (learned[None] == cur_batch[:, None, :]) & (
-            learned[None] != val.NONE
-        )
-        acks = acks | (
-            amatch.T[:, :, None]
-            & (cur_batch != val.NONE)[:, None, :]
-            & (hold | comm)
-        )
-        n_ack = jnp.sum(acks, axis=1)  # [P, I]
-        inst_chosen = (cur_batch != val.NONE) & (n_ack >= quorum)
-        newly = inst_chosen & (pr.commit_vid == val.NONE) & prop_alive[:, None]
-        commit_vid = jnp.where(newly, cur_batch, pr.commit_vid)
+        # Ack accumulation and chosen-detection only on rounds a reply
+        # actually arrives: acks (hence n_ack, hence a new decision)
+        # can only grow here, so skipping the block on reply-free
+        # rounds is exact.  Global predicate as above.
+        any_echo = gany(jnp.any(amatch))
 
-        # Decision metrics (the decision log's source of truth).
-        any_new = jnp.any(newly, axis=0) & (st.met.chosen_vid == val.NONE)
-        new_v = jnp.max(jnp.where(newly, cur_batch, _NEG), axis=0)
-        new_b = jnp.max(jnp.where(newly, pr.ballot[:, None], _NEG), axis=0)
+        def _accum_acks(acks, commit_vid, mvid, mround, mballot):
+            hold = (acc.acc_vid[None] == cur_batch[:, None, :]) & (
+                acc.acc_ballot[None] == pr.ballot[:, None, None]
+            )  # [P, A, I]
+            comm = (learned[None] == cur_batch[:, None, :]) & (
+                learned[None] != val.NONE
+            )
+            acks = acks | (
+                amatch.T[:, :, None]
+                & (cur_batch != val.NONE)[:, None, :]
+                & (hold | comm)
+            )
+            n_ack = jnp.sum(acks, axis=1)  # [P, I]
+            inst_chosen = (cur_batch != val.NONE) & (n_ack >= quorum)
+            newly = (
+                inst_chosen & (commit_vid == val.NONE) & prop_alive[:, None]
+            )
+            commit_vid = jnp.where(newly, cur_batch, commit_vid)
+
+            # Decision metrics (the decision log's source of truth).
+            any_new = jnp.any(newly, axis=0) & (mvid == val.NONE)
+            new_v = jnp.max(jnp.where(newly, cur_batch, _NEG), axis=0)
+            new_b = jnp.max(jnp.where(newly, pr.ballot[:, None], _NEG), axis=0)
+            return (
+                acks,
+                commit_vid,
+                jnp.where(any_new, new_v, mvid),
+                jnp.where(any_new, t, mround),
+                jnp.where(any_new, new_b, mballot),
+                newly,
+            )
+
+        acks, commit_vid, mvid, mround, mballot, newly = jax.lax.cond(
+            any_echo,
+            _accum_acks,
+            lambda ak, cv, v, r, b: (
+                ak, cv, v, r, b, jnp.zeros((p, i_loc), jnp.bool_),
+            ),
+            acks, pr.commit_vid, st.met.chosen_vid, st.met.chosen_round,
+            st.met.chosen_ballot,
+        )
         met = st.met._replace(
-            chosen_vid=jnp.where(any_new, new_v, st.met.chosen_vid),
-            chosen_round=jnp.where(any_new, t, st.met.chosen_round),
-            chosen_ballot=jnp.where(any_new, new_b, st.met.chosen_ballot),
+            chosen_vid=mvid, chosen_round=mround, chosen_ballot=mballot
         )
 
         # COMMIT sends: newly chosen + deadline resends of batches not
@@ -731,17 +871,39 @@ def build_engine(
         # this is exact — the replier has learned the value iff its
         # learned cell equals the committed vid).
         crep = ar.com_rep & alive_a[:, None]  # [A, P]
-        commit_acked = pr.commit_acked | (
-            crep.T[:, :, None]
-            & (commit_vid != val.NONE)[:, None, :]
-            & (learned[None] == commit_vid[:, None, :])
-        )
-        not_all_acked = (commit_vid != val.NONE) & ~jnp.all(
-            commit_acked | st.crashed[None, :, None], axis=1
-        )
-        resend_c = (t >= pr.commit_deadline)[:, None] & not_all_acked
-        send_commit_i = (newly | resend_c) & prop_alive[:, None]  # [P, I]
-        send_commit = gany(jnp.any(send_commit_i, axis=1))
+        any_crep = gany(jnp.any(crep))
+
+        def _accum_commit_acks(commit_acked):
+            ca = commit_acked | (
+                crep.T[:, :, None]
+                & (commit_vid != val.NONE)[:, None, :]
+                & (learned[None] == commit_vid[:, None, :])
+            )
+            # Refresh the cached not-fully-acked flag from the cube —
+            # the only [P, A, I] pass left on the commit path, paid
+            # only when a reply arrives (or every round under crash
+            # faults, where excusal can clear it without any arrival).
+            wait = gany(jnp.any(
+                (commit_vid != val.NONE)
+                & ~jnp.all(ca | st.crashed[None, :, None], axis=1),
+                axis=1,
+            ))  # [P]
+            return ca, wait
+
+        if fc.crash_rate:
+            commit_acked, commit_wait = _accum_commit_acks(pr.commit_acked)
+        else:
+            commit_acked, commit_wait = jax.lax.cond(
+                any_crep,
+                _accum_commit_acks,
+                lambda ca: (ca, pr.commit_wait),
+                pr.commit_acked,
+            )
+        # A fresh decision is by construction not fully acked yet.
+        any_newly = gany(jnp.any(newly, axis=1))  # [P]
+        commit_wait = commit_wait | any_newly
+        resend_c = (t >= pr.commit_deadline) & commit_wait  # [P]
+        send_commit = (any_newly | resend_c) & prop_alive
         commit_deadline = jnp.where(
             send_commit, t + 1 + pc.commit_retry_timeout, pr.commit_deadline
         )
@@ -752,6 +914,15 @@ def build_engine(
         own_has2 = own_assign != val.NONE
         conflict = own_has2 & (learned_p != val.NONE) & (learned_p != own_assign)
         own_done = own_has2 & (learned_p == own_assign)
+        # Completed own-values clear under their own gate (disjoint
+        # from conflicts, so ordering vs the requeue is immaterial);
+        # rounds with neither pay no [P, I] write at all.
+        own_assign = jax.lax.cond(
+            gany(jnp.any(own_done)),
+            lambda oa: jnp.where(own_done, val.NONE, oa),
+            lambda oa: oa,
+            own_assign,
+        )
         # Requeue at most assign_window conflicts per round, in
         # instance order; the remainder keep their own_assign entry and
         # are re-detected next round (drain rate >= the assignment
@@ -779,60 +950,95 @@ def build_engine(
         span = min(2 * r_cap, i_loc)
 
         def _do_requeue(pend, own_assign, ptail):
-            req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
-            take_req = conflict & (req_rank < r_cap)
-            nreq = jnp.sum(take_req, axis=1)  # [P]
             idxb = jnp.broadcast_to(idx[None], conflict.shape)
             has_c = jnp.any(conflict, axis=1)  # [P]
+            ncf = jnp.sum(conflict.astype(jnp.int32), axis=1)  # [P]
             cmin = jnp.min(
                 jnp.where(conflict, idxb, jnp.iinfo(jnp.int32).max), axis=1
             )
             cmax = jnp.max(jnp.where(conflict, idxb, -1), axis=1)
-            fits = jnp.all(~has_c | (cmax - cmin < span))
-            narrow = gall(fits)
+            nreq = jnp.minimum(ncf, r_cap)  # [P]
+            # In a duel the conflicted instances form a FULLY-conflicted
+            # contiguous run (the winner's batch commits as a block over
+            # the loser's contiguous first-fit assignment), so the
+            # first-r_cap-by-instance-order prefix is a padded dynamic
+            # slice at cmin and the taken set is a range test — no sort,
+            # no cumsum.  Sparse sprays (crash leftovers, capped
+            # carry-overs colliding with a new wave) take the sort path.
+            contig = gall(jnp.all(~has_c | (ncf == cmax - cmin + 1)))
 
-            # unstable sorts throughout: conflict keys are unique
-            # (global ids / window offsets) and the sentinel-keyed
-            # remainder is discarded (a stable sort would pay for a
-            # third, hidden iota operand)
-            def _sort_narrow(own_assign):
-                start = jnp.clip(
-                    jnp.where(has_c, cmin - off, 0), 0, i_loc - span
+            def _take_contig(own_assign):
+                startc = jnp.where(has_c, cmin - off, 0)
+                rowpad = jnp.concatenate(
+                    [own_assign, jnp.full((p, r_cap), val.NONE, jnp.int32)],
+                    axis=1,
                 )
 
-                def _slice(row, h):
-                    return jax.lax.dynamic_slice(row, (h,), (span,))
+                def _sl(row, h):
+                    return jax.lax.dynamic_slice(row, (h,), (r_cap,))
 
-                win_conf = jax.vmap(_slice)(conflict, start)
-                win_vids = jax.vmap(_slice)(own_assign, start)
-                keys = jnp.where(
-                    win_conf,
-                    jnp.broadcast_to(
-                        jnp.arange(span, dtype=jnp.int32)[None],
-                        win_conf.shape,
-                    ),
-                    jnp.int32(span),
-                )
-                _, sv = jax.lax.sort(
-                    (keys, win_vids), dimension=1, num_keys=1,
-                    is_stable=False,
-                )
-                return sv[:, :r_cap]
+                block = jax.vmap(_sl)(rowpad, startc)
+                take_req = conflict & (idxb < (cmin + nreq)[:, None])
+                return block, take_req
 
-            def _sort_full(own_assign):
-                sort_keys = jnp.where(conflict, idxb, jnp.int32(i_cap))
-                _, sv = jax.lax.sort(
-                    (sort_keys, own_assign), dimension=1, num_keys=1,
-                    is_stable=False,
-                )
-                return sv[:, :r_cap]
+            def _take_sorted(own_assign):
+                req_rank = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
+                take_req = conflict & (req_rank < r_cap)
+                # Compaction-sort width: conflicts cluster around the
+                # frontier, so when every proposer's spread fits a
+                # 2*r_cap window the sort runs at that width; wider
+                # spreads fall back to the full instance width.  Both
+                # branches produce the same first-r_cap prefix.
+                fits = jnp.all(~has_c | (cmax - cmin < span))
+                narrow = gall(fits)
 
-            sort_prefix = jax.lax.cond(
-                narrow, _sort_narrow, _sort_full, own_assign
+                # unstable sorts throughout: conflict keys are unique
+                # (global ids / window offsets) and the sentinel-keyed
+                # remainder is discarded (a stable sort would pay for a
+                # third, hidden iota operand)
+                def _sort_narrow(own_assign):
+                    start = jnp.clip(
+                        jnp.where(has_c, cmin - off, 0), 0, i_loc - span
+                    )
+
+                    def _slice(row, h):
+                        return jax.lax.dynamic_slice(row, (h,), (span,))
+
+                    win_conf = jax.vmap(_slice)(conflict, start)
+                    win_vids = jax.vmap(_slice)(own_assign, start)
+                    keys = jnp.where(
+                        win_conf,
+                        jnp.broadcast_to(
+                            jnp.arange(span, dtype=jnp.int32)[None],
+                            win_conf.shape,
+                        ),
+                        jnp.int32(span),
+                    )
+                    _, sv = jax.lax.sort(
+                        (keys, win_vids), dimension=1, num_keys=1,
+                        is_stable=False,
+                    )
+                    return sv[:, :r_cap]
+
+                def _sort_full(own_assign):
+                    sort_keys = jnp.where(conflict, idxb, jnp.int32(i_cap))
+                    _, sv = jax.lax.sort(
+                        (sort_keys, own_assign), dimension=1, num_keys=1,
+                        is_stable=False,
+                    )
+                    return sv[:, :r_cap]
+
+                block = jax.lax.cond(
+                    narrow, _sort_narrow, _sort_full, own_assign
+                )
+                return block, take_req
+
+            block, take_req = jax.lax.cond(
+                contig, _take_contig, _take_sorted, own_assign
             )
             req_block = jnp.where(
                 jnp.arange(r_cap)[None] < nreq[:, None],
-                sort_prefix,
+                block,
                 val.NONE,
             )  # [P, R]
             # Slots >= tail are NONE by construction (tail is
@@ -841,7 +1047,7 @@ def build_engine(
             # (capacity proof: tail + nreq <= c, see prepare_queues).
             _, wwrite_r = _window_ops(r_cap)
             pend = jax.vmap(wwrite_r)(pend, req_block, ptail)
-            own2 = jnp.where(take_req | own_done, val.NONE, own_assign)
+            own2 = jnp.where(take_req, val.NONE, own_assign)
             return pend, nreq, own2
 
         pend, nreq, own_assign = jax.lax.cond(
@@ -850,7 +1056,7 @@ def build_engine(
             lambda pend, own_assign, ptail: (
                 pend,
                 jnp.zeros((p,), jnp.int32),
-                jnp.where(own_done, val.NONE, own_assign),
+                own_assign,
             ),
             pend, own_assign, pr.tail,
         )
@@ -872,16 +1078,22 @@ def build_engine(
 
         # Accept deadline: resend outstanding then AcceptRejected ->
         # back to prepare (ref AcceptRetryTimeout, :955-983, 1328-1343).
-        outstanding = (
-            (cur_batch != val.NONE)
-            & (commit_vid == val.NONE)
-            & ~committed_p
-        )
-        adl = (
-            (mode == PREPARED)
-            & gany(jnp.any(outstanding, axis=1))
-            & (t >= acc_deadline)
-            & prop_alive
+        # The [P, I] outstanding scan only runs on rounds a deadline
+        # actually fires (global predicate, cheap [P] inputs).
+        ddl_hit = (mode == PREPARED) & (t >= acc_deadline) & prop_alive
+
+        def _outstanding_any():
+            outstanding = (
+                (cur_batch != val.NONE)
+                & (commit_vid == val.NONE)
+                & (learned[pn] == val.NONE)  # == ~committed_p
+            )
+            return gany(jnp.any(outstanding, axis=1))
+
+        adl = ddl_hit & jax.lax.cond(
+            gany(jnp.any(ddl_hit)),
+            _outstanding_any,
+            lambda: jnp.zeros((p,), jnp.bool_),
         )
         resend_acc = adl & (acc_retries > 1)
         acc_fail = adl & (acc_retries <= 1)
@@ -906,13 +1118,12 @@ def build_engine(
         delay_until = jnp.where(do_restart, t + 1 + rnd_delay, pr.delay_until)
         mode = jnp.where(do_restart, DELAY, mode)
         promises2 = jnp.where(do_restart[:, None], False, promises2)
-        adopted_b = jnp.where(do_restart[:, None], bal.NONE, adopted_b)
-        adopted_v = jnp.where(do_restart[:, None], val.NONE, adopted_v)
-        cur_batch = jnp.where(do_restart[:, None], val.NONE, cur_batch)
-        acks = jnp.where(do_restart[:, None, None], False, acks)
 
         # DELAY -> send prepare with a ballot bumped past everything
-        # seen (ref UpdateProposalID, :792-799).
+        # seen (ref UpdateProposalID, :792-799).  A restarting proposer
+        # can never also start_prep this round (its delay_until is in
+        # the future), so the two clear masks are disjoint and the
+        # combined array-clear cond below is order-independent.
         start_prep = (mode == DELAY) & (t >= delay_until) & prop_alive
         ncount, nballot = bal.bump_past(
             pr.count, pn, jnp.maximum(pmax_seen, pr.ballot)
@@ -925,15 +1136,37 @@ def build_engine(
             start_prep, t + 1 + pc.prepare_retry_timeout, prep_deadline
         )
         promises2 = jnp.where(start_prep[:, None], False, promises2)
-        adopted_b = jnp.where(start_prep[:, None], bal.NONE, adopted_b)
-        adopted_v = jnp.where(start_prep[:, None], val.NONE, adopted_v)
+
+        # The big-array clears (adopted state, batch, ack cube) gate
+        # together on any mode transition this round; quiet rounds
+        # write none of them.
+        any_reset = gany(jnp.any(do_restart | start_prep))
+
+        def _clear_arrays(ab, av, cb, ak):
+            both = (do_restart | start_prep)[:, None]
+            ab = jnp.where(both, bal.NONE, ab)
+            av = jnp.where(both, val.NONE, av)
+            cb = jnp.where(do_restart[:, None], val.NONE, cb)
+            ak = jnp.where(do_restart[:, None, None], False, ak)
+            return ab, av, cb, ak
+
+        adopted_b, adopted_v, cur_batch, acks = jax.lax.cond(
+            any_reset,
+            _clear_arrays,
+            lambda ab, av, cb, ak: (ab, av, cb, ak),
+            adopted_b, adopted_v, cur_batch, acks,
+        )
 
         send_prep = start_prep | resend_prep
         # gany: the network calendars are replicated, so the send
         # predicate must agree across shards even when only some
-        # shards' batches have content
-        send_accept = (now_prepared | added | resend_acc) & gany(
-            jnp.any(cur_batch != val.NONE, axis=1)
+        # shards' batches have content.  The [P, I] batch-content scan
+        # runs only when something wants to send at all.
+        want_acc_send = now_prepared | added | resend_acc
+        send_accept = want_acc_send & jax.lax.cond(
+            gany(jnp.any(want_acc_send)),
+            lambda: gany(jnp.any(cur_batch != val.NONE, axis=1)),
+            lambda: jnp.zeros((p,), jnp.bool_),
         )
 
         # ---------------- network writes ----------------
@@ -1045,7 +1278,7 @@ def build_engine(
         idle_now = (
             (mode == PREPARED)
             & ~gany(jnp.any(inflight, axis=1))
-            & ~gany(jnp.any(not_all_acked, axis=1))  # commit repair in flight
+            & ~commit_wait  # commit repair in flight (cached [P] flag)
             & gall(head == tail)
             & gall(jnp.all(own_assign == val.NONE, axis=1))
             & palive2
@@ -1082,6 +1315,7 @@ def build_engine(
                 commit_acked=commit_acked,
                 commit_deadline=commit_deadline,
                 stall=stall,
+                commit_wait=commit_wait,
             ),
             net=net,
             met=met,

@@ -503,50 +503,81 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
                 axis=1,
             ),
         )
-        # synchronous promise + snapshot reply (committed values at the
-        # sentinel ballot; snap_b [I, A] broadcast over proposers V)
-        snap_b = jnp.where(learned != val.NONE, COMMITTED_BALLOT, acc_ballot)
-        snap_v = jnp.where(learned != val.NONE, learned, acc_vid)
-        repb = jnp.where(
-            grant[:, None, :],
-            jnp.broadcast_to(snap_b[None], (n, i_cap, n)),
-            bal.NONE,
-        )
-        best_ab = jnp.max(repb, axis=-1)  # [V, I]
-        best_aa = jnp.argmax(repb, axis=-1)
-        best_av = jnp.take_along_axis(
-            jnp.broadcast_to(snap_v[None], (n, i_cap, n)), best_aa[..., None], axis=-1
-        )[..., 0]
         n_prom = jnp.sum(grant & acceptors_v, axis=1, dtype=jnp.int32)
         now_prep = want_prep & (n_prom >= quorum_v)
-        adopted_b = jnp.where(now_prep[:, None], jnp.where(best_ab > 0, best_ab, bal.NONE), bal.NONE)
-        adopted_v = jnp.where(
-            now_prep[:, None] & (best_ab > 0), best_av, val.NONE
-        )
         prepared = prepared | now_prep
         delay_until = jnp.where(
             want_prep & ~now_prep, t + 1 + backoff, delay_until
         )
+        # Snapshot reply + adoption + batch skeleton, cond-gated on a
+        # prepare actually being in flight (the port of core/sim.py's
+        # optimization this engine lacked): the old unconditional path
+        # materialized two [V, I, A] cubes (broadcast + argmax +
+        # take_along_axis) every round — at the config-5 literal size
+        # that is ~10^8 wasted elements per quiet round.  Adoption is
+        # a two-pass masked max, exact because cells tied at the max
+        # ballot hold the same value (one proposer per ballot sends
+        # one value per instance; committed-sentinel cells all hold
+        # the agreed chosen value — same argument as core/sim._adopt).
+        any_prep = jnp.any(want_prep)
 
-        # batch skeleton for the newly prepared: adopted + noop holes
-        use_adopt = ~committed_me & (adopted_b != bal.NONE)
-        covered0 = committed_me | use_adopt
-        hi = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)
-        below = idx[None] <= hi[:, None]
-        noop_fill = below & ~covered0
-        use_own = ~below & (own_assign != val.NONE)
-        batch0 = jnp.where(
-            use_adopt,
-            adopted_v,
-            jnp.where(
-                noop_fill,
-                val.noop_vid(idx[None], rows[:, None], i_cap),
-                jnp.where(use_own, own_assign, val.NONE),
-            ),
+        def _adopt_and_build(cur_batch, acks):
+            # committed values at the sentinel ballot; snap_b [I, A]
+            snap_b = jnp.where(
+                learned != val.NONE, COMMITTED_BALLOT, acc_ballot
+            )
+            snap_v = jnp.where(learned != val.NONE, learned, acc_vid)
+            repb = jnp.where(
+                grant[:, None, :],
+                jnp.broadcast_to(snap_b[None], (n, i_cap, n)),
+                bal.NONE,
+            )
+            best_ab = jnp.max(repb, axis=-1)  # [V, I]
+            sel = (repb == best_ab[..., None]) & (repb != bal.NONE)
+            best_av = jnp.max(
+                jnp.where(sel, snap_v[None], jnp.iinfo(jnp.int32).min),
+                axis=-1,
+            )
+            adopted_b = jnp.where(
+                now_prep[:, None],
+                jnp.where(best_ab > 0, best_ab, bal.NONE),
+                bal.NONE,
+            )
+            adopted_v = jnp.where(
+                now_prep[:, None] & (best_ab > 0), best_av, val.NONE
+            )
+
+            # batch skeleton for the newly prepared: adopted + noop holes
+            use_adopt = ~committed_me & (adopted_b != bal.NONE)
+            covered0 = committed_me | use_adopt
+            hi = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)
+            below = idx[None] <= hi[:, None]
+            noop_fill = below & ~covered0
+            use_own = ~below & (own_assign != val.NONE)
+            batch0 = jnp.where(
+                use_adopt,
+                adopted_v,
+                jnp.where(
+                    noop_fill,
+                    val.noop_vid(idx[None], rows[:, None], i_cap),
+                    jnp.where(use_own, own_assign, val.NONE),
+                ),
+            )
+            batch0 = jnp.where(committed_me, val.NONE, batch0)
+            return (
+                adopted_b,
+                adopted_v,
+                jnp.where(now_prep[:, None], batch0, cur_batch),
+                jnp.where(now_prep[:, None, None], False, acks),
+            )
+
+        def _no_prep(cur_batch, acks):
+            nones = jnp.full((n, i_cap), bal.NONE, jnp.int32)
+            return nones, nones, cur_batch, acks
+
+        adopted_b, adopted_v, cur_batch, acks = jax.lax.cond(
+            any_prep, _adopt_and_build, _no_prep, cur_batch, acks
         )
-        batch0 = jnp.where(committed_me, val.NONE, batch0)
-        cur_batch = jnp.where(now_prep[:, None], batch0, cur_batch)
-        acks = jnp.where(now_prep[:, None, None], False, acks)
         batch_age = jnp.where(now_prep, 0, batch_age)
 
         # new-value assignment for prepared proposers (first-fit over

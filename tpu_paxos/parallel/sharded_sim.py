@@ -84,6 +84,7 @@ def _state_specs(axes=INSTANCE_AXIS) -> simm.SimState:
             commit_acked=P(None, None, axes),
             commit_deadline=P(),
             stall=P(),
+            commit_wait=P(),
         ),
         net=jax.tree.map(lambda _: P(), simm.netm.init_buffers(1, 1, 1)),
         met=simm.Metrics(
