@@ -1,12 +1,28 @@
 # Analog of the reference's shell-script surface (ref multi/run.sh,
-# multi/val.sh, member/diff.sh): run, bench, parity-vs-C++, replay-diff.
+# multi/val.sh, member/diff.sh): run, bench, parity-vs-C++, replay-diff,
+# and a sanitizer-mode pass (check, the val.sh analog).
 
 PY ?= python
 
-.PHONY: test bench bench-sharded parity parity-fast replay-diff run stress clean
+.PHONY: test test-slow check bench bench-sharded parity parity-fast \
+	replay-diff replay-diff-member run stress clean
 
+# Fast tier: every feature covered, heavy literal-size / long-schedule
+# variants deselected (marked slow).  ~6 min; test-slow runs everything.
 test:
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+test-slow:
 	$(PY) -m pytest tests/ -x -q
+
+# Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
+# fast tier with NaN-checking on, then an un-jitted op-by-op smoke of
+# one tiny config per engine (every cond predicate, slice bound, and
+# dtype materializes eagerly).  The pallas interpreter path is part of
+# the fast tier (tests/test_fastwin.py).
+check:
+	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
+	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
 bench:
 	$(PY) bench.py
@@ -30,6 +46,13 @@ parity-fast:
 # ref member/diff.sh).
 replay-diff:
 	$(PY) -m pytest tests/test_replay.py -x -q
+
+# Record/replay for a wall-clock-paced membership driver: the host's
+# injection schedule is the one nondeterministic input; record it,
+# replay it, byte-compare decision logs (ref member/run.sh:10-16,
+# member/diff.sh:1-3 — the Indet subsystem's workflow).
+replay-diff-member:
+	$(PY) scripts/replay_diff_member.py
 
 # Randomized sweep: seeds x fault mixes through the general engine,
 # full invariant suite on every run (the reference's stated purpose,

@@ -30,7 +30,9 @@ TPU_PAXOS_BENCH_SHARDED=1 (use every visible device via shard_map —
 BASELINE config 4 shape), TPU_PAXOS_BENCH_DCN_HOSTS (2-D multi-host
 mesh for the sharded paths), TPU_PAXOS_BENCH_SIM_INSTANCES /
 TPU_PAXOS_BENCH_SIM_SHARDED_INSTANCES /
-TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES (secondary record sizes),
+TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES /
+TPU_PAXOS_BENCH_MEMBER_INSTANCES (secondary record sizes),
+TPU_PAXOS_BENCH_MEMBER=0 (skip the membership churn record),
 TPU_PAXOS_BENCH_SECONDARY=0 / TPU_PAXOS_BENCH_SHARDED_CHILD=0 (skip
 secondary records), TPU_PAXOS_BENCH_PROFILE=<dir> (jax profiler
 trace of the timed window).
@@ -297,13 +299,33 @@ def _timed_sim_runs(go, root_for, state0, n_instances: int, config: dict) -> dic
         # Keep only what the record needs; the full SimState (several
         # GiB at bench sizes) frees before the next run.
         runs.append(
-            (dtk, types.SimpleNamespace(met=f.met, t=int(f.t), done=bool(f.done)))
+            (
+                dtk,
+                types.SimpleNamespace(met=f.met, t=int(f.t), done=bool(f.done)),
+                nc,
+            )
         )
         del f
         counts.append(nc)
-    dts = sorted(dt for dt, _ in runs)
-    dt, final = min(runs, key=lambda r: abs(r[0] - dts[1]))  # the median run
+    dts = sorted(dt for dt, _, _ in runs)
+    dt, final, nc_med = min(runs, key=lambda r: abs(r[0] - dts[1]))  # median
     raw = [round(x, 4) for x in dts]
+    # value = n_instances/dt is only meaningful when the selected run
+    # actually resolved the same work as the warmup; a seed that hit
+    # max_rounds part-done must not publish an overstated number —
+    # report the timings without a value instead.
+    if nc_med != warm_count or not final.done:
+        return {
+            "engine": "sim",
+            "error": (
+                f"median run chose {nc_med} instances "
+                f"(done={final.done}), warmup chose {warm_count}; "
+                "value withheld"
+            ),
+            "raw_timings_s": raw,
+            "chosen_counts": {"warmup": warm_count, "timed": counts},
+            "config": config,
+        }
     # Each engine round must stream the whole carried state through HBM
     # at least once — the floor for the bandwidth the timing implies.
     refusal = _implausible(
@@ -314,12 +336,130 @@ def _timed_sim_runs(go, root_for, state0, n_instances: int, config: dict) -> dic
                 "config": config}
     rec = _sim_record(final, dt, n_instances, config)
     rec["raw_timings_s"] = raw
-    # Seed-dependent convergence (a run hitting max_rounds with values
-    # unchosen) is legal — publish it, flagged, rather than losing the
-    # record; identical counts across seeds stay the common case.
+    # A non-median seed diverging is still worth surfacing, flagged.
     if any(c != warm_count for c in counts):
         rec["chosen_counts"] = {"warmup": warm_count, "timed": counts}
     return rec
+
+
+class KernelDivergence(RuntimeError):
+    """The pallas kernel produced different state than the XLA scan —
+    a wrong-answer bug, not an availability problem; never silently
+    fall back from it."""
+
+
+def check_fused_equivalence(n_nodes: int = 5, reps: int = 2) -> None:
+    """On-device CONTENT equivalence of the pallas window kernel vs the
+    XLA scan path: full acc_ballot/acc_vid/learned arrays, not just
+    chosen counts (a content-corrupting kernel bug that preserved
+    counts would otherwise pass).  Runs at a small I on whatever
+    backend is active — bench warmup calls it on the real TPU before
+    every fused headline; tests/test_fastwin.py covers the CPU
+    interpreter and (opt-in) the real chip."""
+    import numpy as np
+
+    from tpu_paxos.core import fastwin
+
+    i = 2 * fastwin.TILE
+    quorum = n_nodes // 2 + 1
+    vids0 = jnp.arange(i, dtype=jnp.int32)
+    ref_step = jax.jit(
+        functools.partial(_steady_state_windows, reps=reps, quorum=quorum)
+    )
+    st_ref, cnt_ref = ref_step(fast.init_state(i, n_nodes), vids0)
+    # iota_vids synthesizes the same arange workload — the variant the
+    # headline actually runs.
+    st_new, cnt = fastwin.steady_state_windows_fused(
+        fast.init_state(i, n_nodes), None, reps=reps, quorum=quorum,
+        iota_vids=True,
+    )
+    if _total(cnt) != _total(cnt_ref):
+        raise KernelDivergence(
+            f"fused kernel chose {_total(cnt)}, scan chose {_total(cnt_ref)}"
+        )
+    for name in ("acc_ballot", "acc_vid", "learned"):
+        a = np.asarray(getattr(st_ref, name))
+        b = np.asarray(getattr(st_new, name))
+        if not (a == b).all():
+            bad = int((a != b).sum())
+            raise KernelDivergence(
+                f"fused kernel diverges from the XLA scan on {name} "
+                f"({bad} of {a.size} cells)"
+            )
+
+
+def bench_member_record() -> dict:
+    """Secondary record: the MEMBERSHIP engine under the BASELINE
+    config-5 churn shape at its literal size (grow the acceptor set
+    1->7 with values in flight, shrink to 5, Applied sequencing) over
+    a sizeable log.  The engine is host-stepped (the reference's
+    member/main.cpp driver model), so the metric is engine rounds/sec
+    including the host's per-round predicate reads — the honest cost
+    model for this engine.  Timing: fresh-state re-runs on the same
+    compiled round (recompiling per seed would dwarf the scenario),
+    slowest-of-2 reported, roofline-guarded like every other record.
+    Default size keeps the record inside the bench budget; set
+    TPU_PAXOS_BENCH_MEMBER_INSTANCES=1048576 for the BASELINE
+    config-5 literal size (tests/test_membership.py runs it on every
+    suite pass)."""
+    from tpu_paxos.membership import engine as meng
+
+    i = int(os.environ.get("TPU_PAXOS_BENCH_MEMBER_INSTANCES", 1 << 17))
+    n = 7
+
+    def scenario(ms):
+        vid = 100
+        for tgt in range(1, 7):
+            ms.propose(0, vid)
+            vid += 1
+            cv = ms.add_acceptor(tgt)
+            if not ms.run_until(lambda: ms.applied(cv), max_rounds=4000):
+                raise RuntimeError(f"churn add {tgt} stalled")
+        for tgt in (6, 5):
+            cv = ms.del_acceptor(tgt)
+            if not ms.run_until(lambda: ms.applied(cv), max_rounds=4000):
+                raise RuntimeError(f"churn del {tgt} stalled")
+        if not ms.run_until(
+            lambda: all(ms.chosen(v) for v in range(100, vid)),
+            max_rounds=4000,
+        ):
+            raise RuntimeError("values unchosen after churn")
+        return int(ms.state.t)
+
+    ms = meng.MemberSim(n_nodes=n, n_instances=i, seed=5)
+    state_bytes = _state_nbytes(ms.state)
+    scenario(ms)  # compile + warm
+    dts, rounds = [], 0
+    for _ in range(2):
+        ms.state = meng._init(n, i, ms.c)
+        ms.injections.clear()  # fresh run: keep the record/replay log
+        # consistent with the state it describes
+        t0 = time.perf_counter()
+        rounds = scenario(ms)
+        dts.append(time.perf_counter() - t0)
+    dt = sorted(dts)[-1]  # slowest of 2: conservative for re-run timing
+    config = {
+        "n_nodes": n,
+        "n_instances": i,
+        "churn": "grow 1->7, shrink to 5, 6 values in flight",
+        "devices": 1,
+        "platform": jax.devices()[0].platform,
+    }
+    raw = [round(x, 4) for x in sorted(dts)]
+    refusal = _implausible(state_bytes * rounds, dt)
+    if refusal is not None:
+        return {"engine": "member", "error": refusal, "raw_timings_s": raw,
+                "config": config}
+    return {
+        "engine": "member",
+        "metric": "member_rounds_per_sec",
+        "value": round(rounds / dt, 1),
+        "unit": "rounds/sec",
+        "rounds": rounds,
+        "wall_s": round(dt, 3),
+        "raw_timings_s": raw,
+        "config": config,
+    }
 
 
 def bench_sharded_child() -> list[dict]:
@@ -574,12 +714,20 @@ def main() -> None:
     # this backend, fall back to the XLA scan rather than losing the
     # bench run — but config errors (ValueError: bad window size, vid
     # space overflow) re-raise, so a typo can't silently demote the
-    # headline to the ~3.6x-slower scan.
+    # headline to the ~3.6x-slower scan.  A fused headline is preceded
+    # by an on-device content-equivalence check against the scan path
+    # (full arrays, small I) so a corrupt kernel can never record a
+    # number.
     fallback_reason = None
     try:
+        if fused:
+            check_fused_equivalence(n_nodes=n_nodes)
         state2, total = step(state, vids0)
         total.block_until_ready()
-    except ValueError:
+    except (ValueError, KernelDivergence):
+        # config errors and wrong-answer kernels both abort loudly; the
+        # fallback below is only for availability failures (a backend
+        # that can't compile/run the kernel at all)
         raise
     except Exception as e:
         if not fused:
@@ -652,6 +800,11 @@ def main() -> None:
             secondary.append(bench_sim_record())
         except Exception as e:
             secondary.append({"engine": "sim", "error": str(e)[:500]})
+        if os.environ.get("TPU_PAXOS_BENCH_MEMBER", "1") == "1":
+            try:
+                secondary.append(bench_member_record())
+            except Exception as e:
+                secondary.append({"engine": "member", "error": str(e)[:500]})
         if os.environ.get("TPU_PAXOS_BENCH_SHARDED_CHILD", "1") == "1":
             try:
                 secondary.extend(_sharded_records_via_subprocess(8))

@@ -1,0 +1,83 @@
+"""Un-jitted op-by-op smoke of one tiny config per engine — the analog
+of the reference's valgrind pass (ref multi/val.sh:1-5, multi/gdb.sh):
+run the same program under a slower, stricter execution mode and
+require the same invariants.  Driven by ``make check`` with
+JAX_DISABLE_JIT=1 (op-by-op eager execution: every lax.cond branch
+predicate, dynamic-slice bound, and dtype actually materializes) and
+JAX_DEBUG_NANS=1.
+
+Tiny configs on purpose: op-by-op execution re-traces every round.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax  # noqa: E402
+
+# Env-var platform selection is too late here (the axon sitecustomize
+# initializes the backend first); switch through jax.config like
+# tests/conftest.py.  Op-by-op through a device tunnel would take
+# minutes per round.
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.config.jax_disable_jit, "run via make check (JAX_DISABLE_JIT=1)"
+
+import numpy as np  # noqa: E402
+
+from tpu_paxos.config import FaultConfig, SimConfig  # noqa: E402
+from tpu_paxos.core import fast, sim  # noqa: E402
+from tpu_paxos.harness import validate  # noqa: E402
+from tpu_paxos.membership.engine import MemberSim  # noqa: E402
+
+
+def smoke_sim() -> None:
+    # fault-free single proposer: ~10 rounds — op-by-op execution pays
+    # per-op dispatch for every round, so the round count is the budget
+    r = sim.run(
+        SimConfig(
+            n_nodes=3,
+            n_instances=4,
+            proposers=(0,),
+            seed=0,
+            max_rounds=60,
+            faults=FaultConfig(),
+        )
+    )
+    assert r.done, f"sim smoke did not quiesce in {r.rounds} rounds"
+    validate.check_agreement(r.learned)
+    validate.check_exactly_once(r.learned, r.expected_vids)
+    print(f"  sim: done in {r.rounds} rounds, all invariants green")
+
+
+def smoke_fast() -> None:
+    n, i = 3, 16
+    state = fast.init_state(i, n)
+    import jax.numpy as jnp
+
+    state, n_chosen = fast.choose_all(
+        state, jnp.arange(i, dtype=jnp.int32), proposer=0, quorum=2
+    )
+    n_chosen = int(n_chosen)
+    assert n_chosen == i, f"fast smoke chose {n_chosen}/{i}"
+    print(f"  fast: {n_chosen}/{i} chosen")
+
+
+def smoke_member() -> None:
+    ms = MemberSim(n_nodes=3, n_instances=8, seed=0)
+    ms.propose(0, 100)
+    assert ms.run_until(lambda: ms.chosen(100), max_rounds=400)
+    cv = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(cv), max_rounds=400)
+    print(f"  member: value chosen + membership change applied, t={int(ms.state.t)}")
+
+
+if __name__ == "__main__":
+    print("check: un-jitted smoke (JAX_DISABLE_JIT=1)")
+    smoke_sim()
+    smoke_fast()
+    smoke_member()
+    print("check: OK")

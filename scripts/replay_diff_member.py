@@ -1,0 +1,82 @@
+"""Record/replay diff for a WALL-CLOCK-paced membership driver — the
+``make replay-diff-member`` body (ref member/diff.sh:1-3 diffs two
+runs' logs; member/run.sh:10-16 is the record-then-replay loop).
+
+The driver below paces its injections by real time (sleeps between
+marks), so WHICH engine round each proposal/membership change lands on
+varies run to run with machine load — exactly the host nondeterminism
+the reference's Indet subsystem records (member/indet.cpp:24-119).
+The injection log captures the schedule that actually happened; the
+replay re-executes it and must produce a byte-identical decision log.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax  # noqa: E402
+
+# Env-var platform selection is too late (axon sitecustomize); switch
+# through jax.config like tests/conftest.py.
+jax.config.update("jax_platforms", "cpu")
+
+from tpu_paxos.membership.engine import MemberSim  # noqa: E402
+
+
+def wall_clock_driver(seed: int) -> MemberSim:
+    """Inject proposals + a membership change at ~15 ms wall-clock
+    marks while the engine free-runs — the round each lands on depends
+    on real time, not on anything deterministic."""
+    ms = MemberSim(n_nodes=5, n_instances=64, seed=seed)
+    plan = [
+        ("propose", 0, 100),
+        ("add", 1),
+        ("propose", 1, 101),
+        ("add", 2),
+        ("propose", 0, 102),
+    ]
+    next_mark = time.monotonic() + 0.015
+    while plan or not all(ms.chosen(v) for v in (100, 101, 102)):
+        ms.run_rounds(1)
+        if plan and time.monotonic() >= next_mark:
+            kind, *args = plan.pop(0)
+            if kind == "propose":
+                ms.propose(args[0], args[1])
+            else:
+                ms.add_acceptor(args[0])
+            next_mark = time.monotonic() + 0.015
+        if int(ms.state.t) > 4000:
+            raise RuntimeError("driver did not converge")
+    return ms
+
+
+def main() -> None:
+    ms = wall_clock_driver(seed=11)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "injections.json")
+        ms.save_injections(path)
+        ms2 = MemberSim.replay(path)
+        rec, rep = ms.decision_log(), ms2.decision_log()
+        ok = rec == rep
+        print(
+            json.dumps(
+                {
+                    "replay_diff_member": ok,
+                    "rounds": int(ms.state.t),
+                    "injections": len(ms.injections),
+                    "log_bytes": len(rec),
+                }
+            )
+        )
+        if not ok:
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

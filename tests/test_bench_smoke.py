@@ -5,6 +5,7 @@ import json
 import os
 import subprocess
 import sys
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -33,6 +34,7 @@ def _run(env_extra):
     return json.loads(lines[0])
 
 
+@pytest.mark.slow
 def test_bench_json_contract():
     """Smoke the headline path plus the secondary sim record at a tiny
     size; the heavyweight sharded subprocess records are exercised by

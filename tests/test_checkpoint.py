@@ -24,6 +24,7 @@ def _setup(cfg):
     return workload, pend, gate, tail, c, root, state, expected
 
 
+@pytest.mark.slow
 def test_resume_equivalence_mid_run(tmp_path):
     cfg = SimConfig(
         n_nodes=5,

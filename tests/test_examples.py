@@ -13,9 +13,21 @@ EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "0*.py")))
 
 @pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
 def test_example_runs(path):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # The axon sitecustomize initializes the backend before env vars
+    # are read, so JAX_PLATFORMS=cpu in the env is silently ignored —
+    # the platform must switch through jax.config before the example's
+    # first device use (same pattern as tests/conftest.py).  Without
+    # this the examples ran through the device tunnel, ~10x slower.
+    env = dict(os.environ)
     p = subprocess.run(
-        [sys.executable, path],
+        [
+            sys.executable,
+            "-c",
+            "import sys, runpy, jax; "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            "runpy.run_path(sys.argv[1], run_name='__main__')",
+            path,
+        ],
         capture_output=True,
         text=True,
         timeout=420,

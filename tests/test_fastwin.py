@@ -3,6 +3,7 @@ XLA scan path it replaces (bench._steady_state_windows) — run here on
 the CPU pallas interpreter; the real kernel runs on TPU in bench.py."""
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -136,3 +137,48 @@ def test_fused_iota_vids_matches_explicit():
         a = np.asarray(getattr(s1, name))
         b = np.asarray(getattr(s2, name))
         assert (a == b).all(), f"{name} diverges in the iota-vid variant"
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    os.environ.get("TPU_PAXOS_TPU_TEST") != "1",
+    reason="drives the real chip; opt in with TPU_PAXOS_TPU_TEST=1",
+)
+def test_fused_matches_scan_on_real_tpu():
+    """Content equivalence on the REAL chip, not the interpreter (the
+    interpreter can't catch TPU-lowering bugs — a kernel that corrupts
+    values while preserving counts would pass the count-only bench
+    asserts).  Runs bench.check_fused_equivalence in a subprocess so
+    the conftest's forced-CPU config doesn't apply; the bench warmup
+    runs the same check before every fused headline."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ":".join(
+        x
+        for x in (
+            repo,
+            env.get("TPU_PAXOS_AXON_SITE", "/root/.axon_site"),
+            env.get("PYTHONPATH", ""),
+        )
+        if x
+    )
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax, bench; "
+            "assert jax.devices()[0].platform == 'tpu', jax.devices(); "
+            "bench.check_fused_equivalence(); print('TPU_EQUIV_OK')",
+        ],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=580,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "TPU_EQUIV_OK" in proc.stdout

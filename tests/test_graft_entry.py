@@ -9,6 +9,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -21,12 +22,14 @@ def test_entry_compiles_and_runs():
     assert int(n_chosen) == args[1].shape[0]
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_with_jax_preinitialized_small():
     """Reproduce the driver environment: jax initialized on a 1-device
     backend before dryrun_multichip is called.  MULTICHIP_r02 failed

@@ -337,6 +337,7 @@ def test_churn_with_crashes_survivors_progress():
     assert (counts == 1).all()
 
 
+@pytest.mark.slow
 def test_churn_at_config5_literal_size():
     """BASELINE config 5 at its literal size: reconfiguration churn
     with a 1M-instance log (grow 1->7 with values in flight, shrink

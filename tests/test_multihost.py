@@ -13,6 +13,7 @@ from tpu_paxos.core import fast
 from tpu_paxos.parallel import mesh as pmesh
 from tpu_paxos.parallel import sharded as psharded
 from tpu_paxos.parallel import sharded_sim
+import pytest
 
 
 def _mesh_2d():
@@ -48,6 +49,7 @@ def test_fast_path_2d_mesh_matches_unsharded():
         assert (a == b).all(), f"{name} diverges on the dcn x ici mesh"
 
 
+@pytest.mark.slow
 def test_sim_engine_2d_mesh_matches_1d():
     cfg = SimConfig(
         n_nodes=5,

@@ -37,6 +37,7 @@ def test_sharded_sim_fault_free_matches_unsharded_set():
     assert _real(r.chosen_vid) == _real(r1.chosen_vid)
 
 
+@pytest.mark.slow
 def test_sharded_sim_under_reference_faults():
     """debug.conf.sample fault rates, dueling proposers, 8 shards."""
     m = pmesh.make_instance_mesh()
@@ -53,6 +54,7 @@ def test_sharded_sim_under_reference_faults():
     assert _real(r.chosen_vid) == _real(r1.chosen_vid)
 
 
+@pytest.mark.slow
 def test_sharded_sim_same_seed_identical():
     """Determinism survives sharding: same seed, same mesh — byte-equal
     decisions (the member/diff.sh property, ref member/run.sh:1-18)."""
@@ -178,6 +180,7 @@ def test_split_workload_forward_and_cross_proposer_reference():
     assert sorted(v for v in r.chosen_vid.tolist() if v >= 0) == [10, 20]
 
 
+@pytest.mark.slow
 def test_sharded_sim_seed4_no_wedge():
     """Regression: an early-drained proposer must not noop-fill shard
     space another proposer's conflict-requeued values still need (the

@@ -242,6 +242,7 @@ def test_crash_minority_safety_and_liveness():
         validate.check_all(r.learned, r.expected_vids)
 
 
+@pytest.mark.slow
 def test_same_seed_identical_outcome():
     """Determinism: the full decision record is a pure function of
     (config, seed) — the engine-level half of the reference's
@@ -262,6 +263,7 @@ def test_same_seed_identical_outcome():
     assert r1.rounds == r2.rounds
 
 
+@pytest.mark.slow
 def test_different_seed_different_schedule():
     """Different seeds must actually change the fault schedule (guards
     against the PRNG being wired to nothing)."""

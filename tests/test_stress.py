@@ -1,8 +1,10 @@
 """Smoke the randomized stress sweep (full sweep is `make stress`)."""
 
 from tpu_paxos.harness import stress
+import pytest
 
 
+@pytest.mark.slow
 def test_stress_sweep_smoke(monkeypatch):
     # two representative mixes, one seed each — the full grid runs via
     # `make stress`

@@ -681,6 +681,24 @@ class MemberSim:
         self._round = jax.jit(
             _build_round(n_nodes, n_instances, self.c, self.root, crash_rate)
         )
+        # Injection log: every (round, op, args) a host driver feeds
+        # in.  The engine itself is a pure function of (seed, round),
+        # but the DRIVER is an arbitrary nondeterministic host program
+        # — it may pace itself by wall clock, sleeps, or external I/O,
+        # so WHICH round each injection lands on is the one piece of
+        # host nondeterminism in the composite.  Recording it makes
+        # the whole run replayable: the TPU-native equivalent of the
+        # reference's Indet record/replay subsystem, which logs every
+        # clock read and lock-acquire order to replay a
+        # nondeterministic host (ref member/indet.h:182-194,
+        # member/indet.cpp:24-119, member/run.sh:10-16).
+        self._init_args = {
+            "n_nodes": n_nodes,
+            "n_instances": n_instances,
+            "seed": seed,
+            "crash_rate": crash_rate,
+        }
+        self.injections: list[list] = []
 
     # -- injection (between rounds, host-side; the reference's
     # Node::Propose / AddAcceptor / DelAcceptor surface) --
@@ -704,6 +722,8 @@ class MemberSim:
             pend=st.pend.at[node, pos].set(vid),
             tail=st.tail.at[node].add(1),
         )
+        # logged only once it actually landed (post-guards)
+        self.injections.append([int(st.t), "propose", [int(node), int(vid)]])
 
     def propose_in_order(
         self, node: int, vids, max_rounds_each: int = 2000
@@ -856,6 +876,79 @@ class MemberSim:
 
     def acceptor_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.acceptors[viewer])).tolist())
+
+    # -- host-injection record / replay (component 9's escape hatch;
+    # ref member/indet.cpp:24-119 record/replay, member/diff.sh:1-3) --
+    def save_injections(self, path) -> None:
+        """Write the injection schedule as the replay artifact: engine
+        geometry + seed, the (round, op, args) stream, and the final
+        round count.  A driver paced by wall clock produces a
+        different schedule every run; the artifact pins the one that
+        happened."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "version": 1,
+                    **self._init_args,
+                    "ops": self.injections,
+                    "final_t": int(self.state.t),
+                },
+                f,
+            )
+
+    @classmethod
+    def replay(cls, path) -> "MemberSim":
+        """Re-execute a recorded run: same engine seed, every injection
+        applied at the recorded round, stepped to the recorded final
+        round.  The result is bit-identical to the recorded run (the
+        engine is deterministic in (seed, round); the log supplies the
+        host's side), decision_log() byte-compares equal."""
+        import json
+
+        with open(path) as f:
+            log = json.load(f)
+        if log.get("version") != 1:
+            raise ValueError(f"unknown injection-log version {log.get('version')}")
+        ms = cls(
+            n_nodes=log["n_nodes"],
+            n_instances=log["n_instances"],
+            seed=log["seed"],
+            crash_rate=log["crash_rate"],
+        )
+        for t_op, op, args in log["ops"]:
+            if int(ms.state.t) > t_op:
+                raise RuntimeError(
+                    f"injection log out of order: at round {int(ms.state.t)} "
+                    f"but op recorded for round {t_op}"
+                )
+            while int(ms.state.t) < t_op:
+                ms.run_rounds(1)
+            if op != "propose":  # every higher-level op records as propose
+                raise ValueError(f"unknown op {op!r} in injection log")
+            ms.propose(*args)
+        while int(ms.state.t) < log["final_t"]:
+            ms.run_rounds(1)
+        return ms
+
+    def decision_log(self) -> str:
+        """Canonical decision-log text — chosen (vid, round, ballot)
+        per instance plus each node's applied log — the byte-compare
+        surface for record-vs-replay (mirrors member/diff.sh diffing
+        two runs' logs)."""
+        st = self.state
+        cv = np.asarray(st.chosen_vid)
+        cr = np.asarray(st.chosen_round)
+        cb = np.asarray(st.chosen_ballot)
+        lines = [
+            f"[{i}] = <{cv[i]}>@{cr[i]}#{cb[i]}"
+            for i in np.flatnonzero(cv != int(val.NONE))
+        ]
+        for node in range(self.n):
+            seq = " ".join(map(str, self.applied_log(node).tolist()))
+            lines.append(f"applied[{node}] = {seq}")
+        return "\n".join(lines) + "\n"
 
     def learner_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.learners[viewer])).tolist())
