@@ -1,0 +1,129 @@
+"""Crash-rejoin durability: a crashed node restores its state from a
+checkpoint, rejoins, and catches up through anti-entropy — prefix
+consistency holds across the whole cluster.  EXCEEDS the reference,
+which persists nothing and aborts the run on any crash (SURVEY §5:
+"promises don't survive a crash"; ref member/indet.h:146-150 is the
+crash injector, member/paxos.cpp:1029-1073 the learner catch-up this
+composes with)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_paxos import checkpoint
+from tpu_paxos.harness import validate
+from tpu_paxos.membership.engine import MemberSim
+
+
+def _grow_to(ms, targets):
+    for tgt in targets:
+        cv = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(cv), max_rounds=2000), tgt
+
+
+def test_crash_checkpoint_rejoin_catches_up(tmp_path):
+    ms = MemberSim(n_nodes=5, n_instances=48, seed=2)
+    _grow_to(ms, (1, 2))
+    ms.propose(0, 100)
+    assert ms.run_until(lambda: ms.chosen(100))
+
+    # fail-stop crash of node 2, then snapshot its (frozen) durable
+    # state — the restart artifact a real deployment would have on disk
+    ms.crash(2)
+    path = os.path.join(tmp_path, "node2.npz")
+    checkpoint.save(path, ms.state, meta={"crashed_node": 2})
+
+    # the cluster makes progress without node 2
+    for v in (101, 102):
+        ms.propose(0, v)
+        assert ms.run_until(lambda: ms.chosen(v))
+    before = len(ms.applied_log(2))
+
+    # simulate the process death losing RAM: node 2's in-memory state
+    # is garbage until the checkpoint restore reconstructs it
+    st = ms.state
+    ms.state = st._replace(
+        learned=st.learned.at[:, 2].set(-1),
+        acc_ballot=st.acc_ballot.at[:, 2].set(-1),
+        acc_vid=st.acc_vid.at[:, 2].set(-1),
+        applied_upto=st.applied_upto.at[2].set(0),
+    )
+
+    ms.rejoin_from_checkpoint(2, path)
+    assert not bool(ms.state.crashed[2])
+
+    # anti-entropy + the apply frontier catch node 2 up: its applied
+    # log reaches the values chosen while it was down
+    assert ms.run_until(
+        lambda: {100, 101, 102} <= set(ms.applied_log(2).tolist()),
+        max_rounds=2000,
+    ), f"node 2 did not catch up (applied {ms.applied_log(2)})"
+    assert len(ms.applied_log(2)) > before
+    validate.check_prefix_consistency(
+        [ms.applied_log(i) for i in range(5)]
+    )
+
+
+def test_rejoin_refuses_pre_crash_checkpoint(tmp_path):
+    # three acceptors so losing one keeps a live majority (2 of 3)
+    ms = MemberSim(n_nodes=3, n_instances=16, seed=0)
+    _grow_to(ms, (1, 2))
+    path = os.path.join(tmp_path, "early.npz")
+    checkpoint.save(path, ms.state)  # node 1 not crashed here
+    ms.crash(1)
+    with pytest.raises(ValueError, match="predates"):
+        ms.rejoin_from_checkpoint(1, path)
+
+
+def test_rejoin_refuses_live_node_and_stale_epoch(tmp_path):
+    """Double-rejoin on a live node, and a snapshot from an earlier
+    crash epoch, are both lost-promise hazards and must be refused."""
+    ms = MemberSim(n_nodes=5, n_instances=48, seed=6)
+    _grow_to(ms, (1, 2))
+    ms.crash(2)
+    ck1 = os.path.join(tmp_path, "epoch1.npz")
+    checkpoint.save(ck1, ms.state)
+    ms.rejoin_from_checkpoint(2, ck1)
+    # live node: a second rejoin must not roll back its state
+    with pytest.raises(ValueError, match="not crashed"):
+        ms.rejoin_from_checkpoint(2, ck1)
+    # progress, then a second crash: the epoch-1 snapshot is stale
+    ms.propose(0, 100)
+    assert ms.run_until(lambda: ms.chosen(100))
+    ms.crash(2)
+    with pytest.raises(ValueError, match="stale epoch"):
+        ms.rejoin_from_checkpoint(2, ck1)
+
+
+def test_crash_guards(tmp_path):
+    ms = MemberSim(n_nodes=3, n_instances=16, seed=0)
+    with pytest.raises(ValueError, match="driver"):
+        ms.crash(0)
+    # acceptor view is {0} only: crashing 1 (a non-acceptor) is fine
+    ms.crash(1)
+    assert 1 in ms.crashed_set()
+
+
+def test_crash_rejoin_replays_bit_identically(tmp_path):
+    """The injection log captures crash + rejoin too, so a recovery
+    scenario replays exactly (the checkpoint artifact is part of the
+    replay inputs)."""
+    ms = MemberSim(n_nodes=5, n_instances=48, seed=4)
+    _grow_to(ms, (1, 2))
+    ms.propose(0, 100)
+    assert ms.run_until(lambda: ms.chosen(100))
+    ms.crash(2)
+    ck = os.path.join(tmp_path, "n2.npz")
+    checkpoint.save(ck, ms.state)
+    ms.propose(0, 101)
+    assert ms.run_until(lambda: ms.chosen(101))
+    ms.rejoin_from_checkpoint(2, ck)
+    assert ms.run_until(
+        lambda: {100, 101} <= set(ms.applied_log(2).tolist())
+    )
+    inj = os.path.join(tmp_path, "inj.json")
+    ms.save_injections(inj)
+    ms2 = MemberSim.replay(inj)
+    assert ms2.decision_log() == ms.decision_log()

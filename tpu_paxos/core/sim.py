@@ -403,13 +403,18 @@ def build_engine(
 
     def round_fn(root: jax.Array, st: SimState) -> SimState:
         # queue rows must be pre-padded by the window width (see
-        # prepare_queues) so window ops are copy-free dynamic slices
+        # prepare_queues) so window ops are copy-free dynamic slices.
+        # ValueError, not assert: this is trace-time-only (zero runtime
+        # cost) and must still fail fast under `python -O` — an
+        # unpadded state (e.g. a checkpoint from before the padding
+        # change) would otherwise silently clamp window slices.
         for _name in ("pend", "gate"):
             _w = getattr(st.prop, _name).shape[-1]
-            assert _w == c + cfg.assign_window, (
-                f"{_name} rows are {_w} wide; expected {c} + "
-                f"assign_window {cfg.assign_window} padding"
-            )
+            if _w != c + cfg.assign_window:
+                raise ValueError(
+                    f"{_name} rows are {_w} wide; expected {c} + "
+                    f"assign_window {cfg.assign_window} padding"
+                )
         t = st.t
         if axis_name is None:
             off = jnp.int32(0)

@@ -699,6 +699,12 @@ class MemberSim:
             "crash_rate": crash_rate,
         }
         self.injections: list[list] = []
+        self.crash_rate = crash_rate
+        # Round at which each node's CURRENT crash was observed — the
+        # rejoin guard ties a checkpoint to this epoch, or a stale
+        # snapshot from an earlier crash of the same node could roll
+        # back promises granted in between (the lost-promise hazard).
+        self._crash_round: dict[int, int] = {}
 
     # -- injection (between rounds, host-side; the reference's
     # Node::Propose / AddAcceptor / DelAcceptor surface) --
@@ -822,6 +828,13 @@ class MemberSim:
     def run_rounds(self, k: int) -> None:
         for _ in range(k):
             self.state = self._round(self.state)
+            if self.crash_rate:
+                # engine-injected crashes don't pass through crash();
+                # observe them so the rejoin epoch guard stays sound
+                # (deterministic: the schedule is a function of
+                # (seed, round), so replays see the same rounds)
+                for nn in np.flatnonzero(np.asarray(self.state.crashed)):
+                    self._crash_round.setdefault(int(nn), int(self.state.t))
         # Capacity proof holds at runtime: the conflict-requeue scatter
         # (mode="drop") must never have been pushed past the ring.
         if int(np.max(np.asarray(self.state.tail))) > self.c:
@@ -877,6 +890,105 @@ class MemberSim:
     def acceptor_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.acceptors[viewer])).tolist())
 
+    # -- crash / rejoin --
+    def crash(self, node: int) -> None:
+        """Inject a deterministic fail-stop crash (the randomized
+        schedule lives in the engine, ref member/indet.h:146-150).
+        Guarded by the same admission rule the engine uses: every
+        survivor must keep a live majority of its own view's
+        acceptors, or the cluster would wedge.  Node 0 is the harness
+        driver and never crashes."""
+        if node == 0:
+            raise ValueError("node 0 is the harness driver; it stays up")
+        st = self.state
+        alive_after = ~np.asarray(st.crashed)
+        alive_after[node] = False
+        acc = np.asarray(st.acceptors)
+        for v in np.flatnonzero(alive_after):
+            q = int(acc[v].sum()) // 2 + 1
+            if int((acc[v] & alive_after).sum()) < q:
+                raise ValueError(
+                    f"crashing node {node} would leave node {v} without "
+                    "a live majority of its acceptor view"
+                )
+        self.state = st._replace(crashed=st.crashed.at[node].set(True))
+        self._crash_round[node] = int(st.t)
+        self.injections.append([int(st.t), "crash", [int(node)]])
+
+    def rejoin_from_checkpoint(self, node: int, path) -> None:
+        """Crash-rejoin durability — EXCEEDS the reference, which
+        persists nothing (SURVEY §5: "promises don't survive a
+        crash"): restore ``node``'s durable per-node state from a
+        checkpoint taken AT OR AFTER its crash, clear the crash bit,
+        and let the engine's anti-entropy pull + apply frontier catch
+        it up.  A crashed node's arrays are frozen (fail-stop), so
+        such a snapshot equals its state at the failure point —
+        restoring an earlier snapshot would be the classic
+        lost-promise hazard (promises granted between snapshot and
+        crash forgotten), which is why the checkpoint must show the
+        node already crashed."""
+        from tpu_paxos import checkpoint as ckpt
+
+        st = self.state
+        if not bool(st.crashed[node]):
+            # double-rejoin / live-node call: restoring would roll a
+            # LIVE node's promises back to crash-time values
+            raise ValueError(
+                f"node {node} is not crashed; rejoin would overwrite "
+                "live state with the snapshot"
+            )
+        snap, _meta = ckpt.restore(path, like=st)
+        if not bool(snap.crashed[node]):
+            raise ValueError(
+                f"checkpoint predates node {node}'s crash — restoring it "
+                "would forget promises granted after the snapshot"
+            )
+        cr = self._crash_round.get(node)
+        if cr is not None and int(snap.t) < cr:
+            # a snapshot from an EARLIER crash epoch of the same node:
+            # promises granted between its rejoin and the current
+            # crash would be forgotten
+            raise ValueError(
+                f"checkpoint is from round {int(snap.t)}, before node "
+                f"{node}'s current crash at round {cr} — stale epoch"
+            )
+
+        # Per-node leaves, restored by their node-axis position; the
+        # completeness check below turns a future MemberState field
+        # that is neither listed nor global into a hard failure
+        # instead of a silently-unrestored leaf.
+        node_major = (
+            "learners", "proposers", "acceptors", "version", "promised",
+            "max_seen", "applied_upto", "count", "ballot", "pmax",
+            "prepared", "delay_until", "adopted_b", "adopted_v",
+            "cur_batch", "acks", "batch_age", "own_assign", "pend",
+            "head", "tail", "stall",
+        )
+        node_minor = ("acc_ballot", "acc_vid", "learned")  # [I, N]
+        cluster_global = {"t", "chosen_vid", "chosen_round", "chosen_ballot"}
+        kw = {"crashed": st.crashed.at[node].set(False)}
+        for f in node_major:
+            kw[f] = getattr(st, f).at[node].set(getattr(snap, f)[node])
+        for f in node_minor:
+            kw[f] = getattr(st, f).at[:, node].set(
+                getattr(snap, f)[:, node]
+            )
+        uncovered = set(type(st)._fields) - set(kw) - cluster_global
+        if uncovered:
+            raise RuntimeError(
+                "rejoin_from_checkpoint does not cover MemberState "
+                f"fields {sorted(uncovered)}; classify them as "
+                "node-major, node-minor, or cluster-global"
+            )
+        self.state = st._replace(**kw)
+        self._crash_round.pop(node, None)
+        # Replaying a rejoin needs the checkpoint artifact to still
+        # exist at the recorded path (the engine re-derives the same
+        # state, but the restore step reads the file).
+        self.injections.append(
+            [int(st.t), "rejoin", [int(node), str(path)]]
+        )
+
     # -- host-injection record / replay (component 9's escape hatch;
     # ref member/indet.cpp:24-119 record/replay, member/diff.sh:1-3) --
     def save_injections(self, path) -> None:
@@ -925,9 +1037,14 @@ class MemberSim:
                 )
             while int(ms.state.t) < t_op:
                 ms.run_rounds(1)
-            if op != "propose":  # every higher-level op records as propose
+            if op == "propose":  # add/del/transition ops record as propose
+                ms.propose(*args)
+            elif op == "crash":
+                ms.crash(*args)
+            elif op == "rejoin":
+                ms.rejoin_from_checkpoint(*args)
+            else:
                 raise ValueError(f"unknown op {op!r} in injection log")
-            ms.propose(*args)
         while int(ms.state.t) < log["final_t"]:
             ms.run_rounds(1)
         return ms
