@@ -391,6 +391,9 @@ def build_engine(
 
         def gany(b):
             return b
+
+        def gsum(x):
+            return x
     else:
         def gmax(x):
             return jax.lax.pmax(x, axis_name)
@@ -398,8 +401,22 @@ def build_engine(
         def gany(b):
             return jax.lax.pmax(b.astype(jnp.int32), axis_name).astype(bool)
 
+        def gsum(x):
+            return jax.lax.psum(x, axis_name)
+
     def gall(b):
         return ~gany(~b)
+
+    # rany: an any-reduction over REPLICATED inputs — network arrivals
+    # (the calendars are replicated), [P]/[A] protocol scalars, and
+    # values already derived from collective outputs.  Every shard
+    # computes the identical result, so consistent branching needs no
+    # collective; issuing one anyway (as earlier rounds of this code
+    # did) adds a tiny latency-bound collective per site per round on
+    # a real mesh.  Use gany ONLY when the reduced value involves
+    # instance-sharded data.
+    def rany(b):
+        return jnp.any(b)
 
     def round_fn(root: jax.Array, st: SimState) -> SimState:
         # queue rows must be pre-padded by the window width (see
@@ -469,7 +486,7 @@ def build_engine(
         # predicate so every shard branches identically.  When the
         # branch is skipped the acceptor arrays pass through
         # untouched, exactly what the all-false cube would produce.
-        any_acc_arr = gany(jnp.any(elig))
+        any_acc_arr = rany(elig)
 
         def _store_accepts(acc_ballot, acc_vid):
             # Per-instance ack: store-or-match (see module docstring
@@ -524,7 +541,7 @@ def build_engine(
         cbat = st.prop.commit_vid  # [P, I]
         # Same gating pattern as the accept store: the [P, A, I]
         # delivery cube only on rounds a commit actually arrives.
-        any_com_arr = gany(jnp.any(cpres))
+        any_com_arr = rany(cpres)
 
         def _learn_commits(learned):
             # Unrolled over P like _store_accepts: a running
@@ -565,7 +582,7 @@ def build_engine(
         # (rare) phase-1 — the acceptor snapshot and the [P, A, I]
         # adoption passes run under a cond (global predicate: every
         # shard branches identically).
-        any_reply = gany(jnp.any(match))
+        any_reply = rany(match)
 
         def _adopt(ab, av):
             # Accepted-state snapshot at delivery (pre-round state —
@@ -617,7 +634,7 @@ def build_engine(
         # of times per run — the whole skeleton is cond-gated (global
         # predicate: the gmax inside must branch identically on every
         # shard).
-        any_p1 = gany(jnp.any(now_prepared))
+        any_p1 = rany(now_prepared)
 
         def _build_batches(cur_batch, acks):
             committed_p = learned[pn] != val.NONE  # [P, I]
@@ -821,7 +838,7 @@ def build_engine(
         # actually arrives: acks (hence n_ack, hence a new decision)
         # can only grow here, so skipping the block on reply-free
         # rounds is exact.  Global predicate as above.
-        any_echo = gany(jnp.any(amatch))
+        any_echo = rany(amatch)
 
         def _accum_acks(acks, commit_vid, mvid, mround, mballot):
             hold = (acc.acc_vid[None] == cur_batch[:, None, :]) & (
@@ -876,7 +893,7 @@ def build_engine(
         # this is exact — the replier has learned the value iff its
         # learned cell equals the committed vid).
         crep = ar.com_rep & alive_a[:, None]  # [A, P]
-        any_crep = gany(jnp.any(crep))
+        any_crep = rany(crep)
 
         def _accum_commit_acks(commit_acked):
             ca = commit_acked | (
@@ -1096,7 +1113,7 @@ def build_engine(
             return gany(jnp.any(outstanding, axis=1))
 
         adl = ddl_hit & jax.lax.cond(
-            gany(jnp.any(ddl_hit)),
+            rany(ddl_hit),
             _outstanding_any,
             lambda: jnp.zeros((p,), jnp.bool_),
         )
@@ -1145,7 +1162,7 @@ def build_engine(
         # The big-array clears (adopted state, batch, ack cube) gate
         # together on any mode transition this round; quiet rounds
         # write none of them.
-        any_reset = gany(jnp.any(do_restart | start_prep))
+        any_reset = rany(do_restart | start_prep)
 
         def _clear_arrays(ab, av, cb, ak):
             both = (do_restart | start_prep)[:, None]
@@ -1169,7 +1186,7 @@ def build_engine(
         # runs only when something wants to send at all.
         want_acc_send = now_prepared | added | resend_acc
         send_accept = want_acc_send & jax.lax.cond(
-            gany(jnp.any(want_acc_send)),
+            rany(want_acc_send),
             lambda: gany(jnp.any(cur_batch != val.NONE, axis=1)),
             lambda: jnp.zeros((p,), jnp.bool_),
         )
@@ -1259,17 +1276,37 @@ def build_engine(
         # ---------------- quiescence ----------------
         alive2 = ~crashed
         palive2 = alive2[pn]
-        q_empty = gall(jnp.all((head == tail) | ~palive2))
-        own_none = gall(jnp.all((own_assign == val.NONE) | ~palive2[:, None]))
+        # Packed reductions: the naive formulation issues ~8 small
+        # collectives here, two of them CHAINED (hole and learned
+        # checks needed the global high-water mark first).  Counting
+        # reformulation instead: chosen instances are distinct cells,
+        # so contiguity is `global chosen count == hmax + 1`, and a
+        # node has learned everything below the frontier iff its
+        # global learned count matches (learned ⊆ chosen, so no
+        # learned cell sits above hmax).  Everything folds into ONE
+        # psum vector plus ONE pmax scalar, issued in parallel.
+        # Unsharded, gsum/gmax are identity and the math is unchanged.
+        inflight = (cur_batch != val.NONE) & (met.chosen_vid[None] == val.NONE)
+        local = jnp.concatenate([
+            jnp.sum(met.chosen_vid != val.NONE, dtype=jnp.int32)[None],
+            jnp.sum(learned != val.NONE, axis=1, dtype=jnp.int32),  # [A]
+            jnp.sum(inflight, axis=1, dtype=jnp.int32),  # [P]
+            (head != tail).astype(jnp.int32),  # [P] per-shard queues
+            jnp.sum(own_assign != val.NONE, axis=1, dtype=jnp.int32),  # [P]
+        ])
+        sums = gsum(local)
         hmax = gmax(jnp.max(
             jnp.where(met.chosen_vid != val.NONE, idx, -1)
         ))
-        contiguous = gall(jnp.all(
-            (met.chosen_vid != val.NONE) | (idx > hmax)
-        ))
-        learned_ok = gall(jnp.all(
-            (learned != val.NONE) | crashed[:, None] | (idx[None, :] > hmax)
-        ))
+        n_chosen = sums[0]
+        n_learned = sums[1:1 + a]  # [A] global learned count per node
+        inflight_n = sums[1 + a:1 + a + p]  # [P]
+        q_pending = sums[1 + a + p:1 + a + 2 * p]  # [P] shards w/ queue
+        own_n = sums[1 + a + 2 * p:1 + a + 3 * p]  # [P]
+        q_empty = ~jnp.any(palive2 & (q_pending > 0))
+        own_none = ~jnp.any(palive2 & (own_n > 0))
+        contiguous = n_chosen == hmax + 1
+        learned_ok = jnp.all((n_learned == hmax + 1) | crashed)
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
 
         # Stall accounting for the idle-liveness restart: a proposer is
@@ -1279,13 +1316,12 @@ def build_engine(
         # chosen high-water mark, or chosen values some live node
         # never learned).
         unresolved = ~(contiguous & learned_ok)
-        inflight = (cur_batch != val.NONE) & (met.chosen_vid[None] == val.NONE)
         idle_now = (
             (mode == PREPARED)
-            & ~gany(jnp.any(inflight, axis=1))
+            & (inflight_n == 0)
             & ~commit_wait  # commit repair in flight (cached [P] flag)
-            & gall(head == tail)
-            & gall(jnp.all(own_assign == val.NONE, axis=1))
+            & (q_pending == 0)
+            & (own_n == 0)
             & palive2
         )
         stall = jnp.where(idle_now & unresolved & ~done, pr.stall + 1, 0)
