@@ -570,11 +570,17 @@ def build_engine(
 
         # PREPARE_REPLY arrivals: promises + adoption merge.  The
         # accepted-state snapshot is the acceptor's state at delivery
-        # (the pre-round snap_b/snap_v inside _adopt below) —
-        # equivalent to the acceptor processing the prepare at the
-        # delivery round, which is strictly safer: its promise took
-        # effect earlier, and a fresher snapshot's max-ballot value is
-        # exactly what a later-generated reply would report.
+        # INCLUDING this round's accept/commit updates (the post-round
+        # snap_b/snap_v inside _adopt below) — equivalent to the
+        # acceptor generating its reply at the end of the delivery
+        # round, which is strictly safer: its promise took effect
+        # earlier, and a fresher snapshot's max-ballot value is
+        # exactly what a later-generated reply would report.  Using
+        # the post-update arrays (rather than reaching back to
+        # st.acc/st.learned) also ends the pre-round buffers' liveness
+        # at the accept/commit conds, letting XLA alias their
+        # pass-through branches instead of copying [A, I] carries
+        # every round.
         pecho = jnp.where(alive_a[:, None], ar.prep_echo, bal.NONE)  # [A, P]
         match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
         promises2 = pr.promises | match.T  # [P, A]
@@ -585,16 +591,17 @@ def build_engine(
         any_reply = rany(match)
 
         def _adopt(ab, av):
-            # Accepted-state snapshot at delivery (pre-round state —
-            # st.acc / st.learned, NOT this round's updates);
-            # committed values are included at COMMITTED_BALLOT (ref
+            # Accepted-state snapshot at delivery (this round's
+            # updated arrays — see the block comment above for why a
+            # fresher snapshot is legal and cheaper); committed values
+            # are included at COMMITTED_BALLOT (ref
             # FilterAcceptedValues includes committed_values_,
             # multi/paxos.cpp:913-922).
             snap_b = jnp.where(
-                st.learned != val.NONE, COMMITTED_BALLOT, st.acc.acc_ballot
+                learned != val.NONE, COMMITTED_BALLOT, acc.acc_ballot
             )
             snap_v = jnp.where(
-                st.learned != val.NONE, st.learned, st.acc.acc_vid
+                learned != val.NONE, learned, acc.acc_vid
             )
             # Adoption merge as two fused masked-max passes (argmax +
             # take_along_axis gather cost ~1/3 of the whole round's
