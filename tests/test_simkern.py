@@ -1,0 +1,164 @@
+"""The sim-engine pallas kernels (core/simkern.py) must be
+bit-identical to the jnp formulations they replace — checked here on
+the CPU pallas interpreter over randomized inputs, and (opt-in) by
+running the whole engine both ways on the real chip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import simkern
+from tpu_paxos.core import values as val
+
+A, P = 5, 2
+I = simkern.TILE  # one whole tile
+
+
+def _rand_state(seed):
+    r = np.random.RandomState(seed)
+    # sparse accepted state with realistic sentinels
+    acc_ballot = np.where(
+        r.rand(A, I) < 0.3, r.randint(1, 1 << 18, (A, I)), int(bal.NONE)
+    ).astype(np.int32)
+    acc_vid = np.where(
+        acc_ballot != int(bal.NONE), r.randint(0, 1 << 20, (A, I)), int(val.NONE)
+    ).astype(np.int32)
+    learned = np.where(
+        r.rand(A, I) < 0.2, r.randint(0, 1 << 20, (A, I)), int(val.NONE)
+    ).astype(np.int32)
+    abat = np.where(
+        r.rand(P, I) < 0.7, r.randint(0, 1 << 20, (P, I)), int(val.NONE)
+    ).astype(np.int32)
+    abal = r.randint(1, 1 << 18, (P,)).astype(np.int32)
+    return acc_ballot, acc_vid, learned, abat, abal, r
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_store_accepts_matches_jnp(seed):
+    acc_ballot, acc_vid, learned, abat, abal, r = _rand_state(seed)
+    elig = (r.rand(P, A) < 0.6).astype(bool)
+
+    # jnp reference: the exact loop from core/sim.py's _store_accepts
+    is_comm = learned != int(val.NONE)
+    best_b = np.full_like(acc_ballot, int(bal.NONE))
+    best_v = np.full_like(acc_vid, int(val.NONE))
+    for pi in range(P):
+        batp = abat[pi]
+        ackp = (
+            elig[pi][:, None]
+            & (batp != int(val.NONE))[None, :]
+            & np.where(is_comm, batp[None, :] == learned, abal[pi] >= acc_ballot)
+        )
+        candp = np.where(ackp & ~is_comm, abal[pi], int(bal.NONE))
+        take = candp > best_b
+        best_b = np.where(take, candp, best_b)
+        best_v = np.where(take, np.broadcast_to(batp[None, :], best_v.shape), best_v)
+    do_store = best_b != int(bal.NONE)
+    want_b = np.where(do_store, best_b, acc_ballot)
+    want_v = np.where(do_store, best_v, acc_vid)
+
+    got_b, got_v = simkern.store_accepts(
+        jnp.asarray(acc_ballot), jnp.asarray(acc_vid), jnp.asarray(learned),
+        jnp.asarray(abat), jnp.asarray(abal), jnp.asarray(elig),
+        interpret=True,
+    )
+    assert (np.asarray(got_b) == want_b).all()
+    assert (np.asarray(got_v) == want_v).all()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_accum_acks_matches_jnp(seed):
+    acc_ballot, acc_vid, learned, cur_batch, ballot, r = _rand_state(seed)
+    acks = (r.rand(P, A, I) < 0.2).astype(np.int8)
+    amatch_pa = (r.rand(P, A) < 0.6).astype(bool)
+
+    hold = (acc_vid[None] == cur_batch[:, None, :]) & (
+        acc_ballot[None] == ballot[:, None, None]
+    )
+    comm = (learned[None] == cur_batch[:, None, :]) & (
+        learned[None] != int(val.NONE)
+    )
+    want = acks | (
+        amatch_pa[:, :, None]
+        & (cur_batch != int(val.NONE))[:, None, :]
+        & (hold | comm)
+    ).astype(np.int8)
+    want_n = want.sum(axis=1, dtype=np.int32)
+
+    got, got_n = simkern.accum_acks(
+        jnp.asarray(acks), jnp.asarray(cur_batch), jnp.asarray(acc_ballot),
+        jnp.asarray(acc_vid), jnp.asarray(learned), jnp.asarray(ballot),
+        jnp.asarray(amatch_pa), interpret=True,
+    )
+    assert (np.asarray(got) == want).all()
+    assert (np.asarray(got_n) == want_n).all()
+
+
+@pytest.mark.tpu
+@pytest.mark.skipif(
+    os.environ.get("TPU_PAXOS_TPU_TEST") != "1",
+    reason="drives the real chip; opt in with TPU_PAXOS_TPU_TEST=1",
+)
+def test_engine_pallas_matches_jnp_on_real_tpu():
+    """Run a whole faulty engine config on the chip with the kernels
+    on and off; final decisions and acceptor state must be
+    bit-identical."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ":".join(
+        x
+        for x in (
+            repo,
+            env.get("TPU_PAXOS_AXON_SITE", "/root/.axon_site"),
+            env.get("PYTHONPATH", ""),
+        )
+        if x
+    )
+    env.pop("JAX_PLATFORMS", None)
+    code = """
+import jax, numpy as np
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.utils import prng
+i = simm_tile = __import__('tpu_paxos.core.simkern', fromlist=['TILE']).TILE * 2
+cfg = SimConfig(n_nodes=5, n_instances=i, proposers=(0, 1), seed=0,
+                max_rounds=4000,
+                faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2))
+workload = simm.default_workload(cfg)
+pend, gate, tail, c = simm.prepare_queues(cfg, workload)
+root = prng.root_key(cfg.seed)
+finals = []
+for up in (True, False):
+    st = simm.init_state(cfg, pend, gate, tail, root)
+    fn = simm.build_engine(cfg, c, use_pallas=up)
+    go = jax.jit(lambda r, s: jax.lax.while_loop(
+        lambda x: (~x.done) & (x.t < cfg.max_rounds), lambda x: fn(r, x), s))
+    finals.append(go(root, st))
+a, b = finals
+assert bool(a.done) and bool(b.done)
+for name in ('chosen_vid', 'chosen_round', 'chosen_ballot'):
+    x, y = np.asarray(getattr(a.met, name)), np.asarray(getattr(b.met, name))
+    assert (x == y).all(), name
+for get in (lambda s: s.acc.acc_ballot, lambda s: s.acc.acc_vid,
+            lambda s: s.learned):
+    assert (np.asarray(get(a)) == np.asarray(get(b))).all()
+print('SIMKERN_TPU_OK')
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=580,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "SIMKERN_TPU_OK" in proc.stdout

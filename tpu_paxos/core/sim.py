@@ -124,7 +124,10 @@ class ProposerState(NamedTuple):
     adopted_b: jax.Array  # [P, I] int32 adopted pre-accepted ballot
     adopted_v: jax.Array  # [P, I] int32 adopted pre-accepted vid
     cur_batch: jax.Array  # [P, I] int32 vids being accepted at ballot
-    acks: jax.Array  # [P, A, I] bool per-instance accept acks
+    acks: jax.Array  # [P, A, I] int8 0/1 per-instance accept acks
+    #     (int8, not bool: the pallas ack kernel reads it natively —
+    #     mosaic backs i1 operands with i32, which would 4x the
+    #     cube's HBM traffic and overflow scoped VMEM)
     acc_deadline: jax.Array  # [P] int32
     acc_retries: jax.Array  # [P] int32
     own_assign: jax.Array  # [P, I] int32 own initial proposals by instance
@@ -252,7 +255,7 @@ def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
             adopted_b=none(p, i),
             adopted_v=none(p, i),
             cur_batch=none(p, i),
-            acks=jnp.zeros((p, a, i), jnp.bool_),
+            acks=jnp.zeros((p, a, i), jnp.int8),
             acc_deadline=jnp.zeros((p,), jnp.int32),
             acc_retries=jnp.zeros((p,), jnp.int32),
             own_assign=none(p, i),
@@ -354,6 +357,7 @@ def build_engine(
     axis_name: str | tuple[str, ...] | None = None,
     n_shards: int = 1,
     vid_cap: int = 0,
+    use_pallas: bool | None = None,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
@@ -384,6 +388,23 @@ def build_engine(
         raise ValueError(f"n_instances {i_cap} not divisible by {n_shards}")
     i_loc = i_cap // n_shards  # instances per shard ([I]-axis array size)
     max_crash = (a - 1) // 2
+    from tpu_paxos.core import simkern as _sk
+
+    if use_pallas is None:
+        # Fused single-pass kernels for the two hottest event blocks
+        # (core/simkern.py) on TPU backends at supported geometries;
+        # the jnp formulations below stay canonical and run everywhere
+        # else (bit-identical — tests/test_simkern.py).
+        use_pallas = (
+            jax.default_backend() == "tpu" and _sk.supported(i_loc, a, p)
+        )
+    elif use_pallas and not _sk.supported(i_loc, a, p):
+        # an explicit request outside the kernels' envelope must fail
+        # loudly, not truncate the grid
+        raise ValueError(
+            f"use_pallas=True unsupported for geometry (I={i_loc}, "
+            f"A={a}, P={p}); see simkern.supported()"
+        )
 
     if axis_name is None:
         def gmax(x):
@@ -489,6 +510,12 @@ def build_engine(
         any_acc_arr = rany(elig)
 
         def _store_accepts(acc_ballot, acc_vid):
+            if use_pallas:
+                from tpu_paxos.core import simkern
+
+                return simkern.store_accepts(
+                    acc_ballot, acc_vid, learned, abat, abal, elig
+                )
             # Per-instance ack: store-or-match (see module docstring
             # for the deviation from the reference's blanket batch
             # ack).  The proposer axis is UNROLLED (P is a small
@@ -684,7 +711,7 @@ def build_engine(
             batch0 = jnp.where(committed_p, val.NONE, batch0)
             return (
                 jnp.where(now_prepared[:, None], batch0, cur_batch),
-                jnp.where(now_prepared[:, None, None], False, acks),
+                jnp.where(now_prepared[:, None, None], jnp.int8(0), acks),
             )
 
         cur_batch, acks = jax.lax.cond(
@@ -848,18 +875,26 @@ def build_engine(
         any_echo = rany(amatch)
 
         def _accum_acks(acks, commit_vid, mvid, mround, mballot):
-            hold = (acc.acc_vid[None] == cur_batch[:, None, :]) & (
-                acc.acc_ballot[None] == pr.ballot[:, None, None]
-            )  # [P, A, I]
-            comm = (learned[None] == cur_batch[:, None, :]) & (
-                learned[None] != val.NONE
-            )
-            acks = acks | (
-                amatch.T[:, :, None]
-                & (cur_batch != val.NONE)[:, None, :]
-                & (hold | comm)
-            )
-            n_ack = jnp.sum(acks, axis=1)  # [P, I]
+            if use_pallas:
+                from tpu_paxos.core import simkern
+
+                acks, n_ack = simkern.accum_acks(
+                    acks, cur_batch, acc.acc_ballot, acc.acc_vid,
+                    learned, pr.ballot, amatch.T,
+                )
+            else:
+                hold = (acc.acc_vid[None] == cur_batch[:, None, :]) & (
+                    acc.acc_ballot[None] == pr.ballot[:, None, None]
+                )  # [P, A, I]
+                comm = (learned[None] == cur_batch[:, None, :]) & (
+                    learned[None] != val.NONE
+                )
+                acks = acks | (
+                    amatch.T[:, :, None]
+                    & (cur_batch != val.NONE)[:, None, :]
+                    & (hold | comm)
+                ).astype(jnp.int8)
+                n_ack = jnp.sum(acks, axis=1, dtype=jnp.int32)  # [P, I]
             inst_chosen = (cur_batch != val.NONE) & (n_ack >= quorum)
             newly = (
                 inst_chosen & (commit_vid == val.NONE) & prop_alive[:, None]
@@ -1176,7 +1211,7 @@ def build_engine(
             ab = jnp.where(both, bal.NONE, ab)
             av = jnp.where(both, val.NONE, av)
             cb = jnp.where(do_restart[:, None], val.NONE, cb)
-            ak = jnp.where(do_restart[:, None, None], False, ak)
+            ak = jnp.where(do_restart[:, None, None], jnp.int8(0), ak)
             return ab, av, cb, ak
 
         adopted_b, adopted_v, cur_batch, acks = jax.lax.cond(

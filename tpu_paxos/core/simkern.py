@@ -1,0 +1,188 @@
+"""Pallas kernels for the general engine's two hottest event blocks.
+
+The gated round (core/sim.py) pays most of its event-round time in two
+[A, I]-wide blocks that XLA lowers to ~2.5x their bandwidth floor
+(multiple fusions re-reading the same operands):
+
+- the ACCEPT-STORE: per (a, i) pick the max-ballot eligible incoming
+  accept across proposers and store it (ref multi/paxos.cpp:1359-1397
+  OnAccept, with the safe-acceptor deviation documented in
+  core/sim.py);
+- the ECHO-ACK accumulation: per (p, a, i) certify an accept reply by
+  store-or-match against the acceptor's current state and fold the
+  per-instance ack counts (ref multi/paxos.cpp:1407-1444
+  OnAcceptReply).
+
+Each kernel runs ONE fused HBM pass per event round: every operand
+read exactly once, outputs written exactly once (acceptor arrays and
+the ack cube aliased in place), with the per-proposer loop unrolled in
+VMEM.  Semantics are bit-identical to the jnp formulations in
+core/sim.py (pinned by tests/test_simkern.py on the interpreter and,
+opt-in, on the real chip) — the jnp path stays canonical and is what
+every non-TPU backend runs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import values as val
+
+_B_NONE = int(bal.NONE)
+_V_NONE = int(val.NONE)
+
+# [A, TILE] int32 tiles: the store kernel holds ~4 refs of A rows, the
+# ack kernel adds the [P, A, TILE] cube; 32k instances per tile keeps
+# both inside VMEM with double buffering at A, P <= 9.
+TILE = 32768
+
+
+def supported(n_instances: int, n_nodes: int = 5, n_proposers: int = 2) -> bool:
+    """The kernels require whole tiles AND the A/P envelope the TILE
+    sizing was budgeted for (the ack kernel's [P, A, TILE] cube plus
+    ~4 [A, TILE] refs must fit double-buffered VMEM); core/sim.py
+    falls back to the jnp path otherwise (and on every non-TPU
+    backend)."""
+    return n_instances % TILE == 0 and n_nodes <= 9 and n_proposers <= 9
+
+
+def _check_aligned(i: int) -> None:
+    # A truncated grid would silently skip the tail AND leave the
+    # non-aliased n_ack output uninitialized — hard error, never
+    # garbage.
+    if i % TILE:
+        raise ValueError(
+            f"n_instances ({i}) is not a multiple of TILE ({TILE}); "
+            "use the jnp path (simkern.supported() gates this)"
+        )
+
+
+def _store_kernel(scals_ref, bat_ref, ab_in, av_in, lr_ref, ab_out, av_out):
+    """scals: [P] abal then [P*A] elig (int32 0/1), row-major."""
+    a, _ = ab_in.shape
+    p, _ = bat_ref.shape
+    ab = ab_in[:, :]
+    av = av_in[:, :]
+    is_comm = lr_ref[:, :] != _V_NONE  # [A, T]
+    best_b = jnp.full_like(ab, _B_NONE)
+    best_v = jnp.full_like(av, _V_NONE)
+    for pi in range(p):
+        abal_p = scals_ref[pi]
+        # per-acceptor eligibility column for this proposer: [A, 1]
+        elig_p = jnp.stack(
+            [scals_ref[p + pi * a + ai] for ai in range(a)]
+        )[:, None] != 0
+        batp = bat_ref[pi, :][None, :]  # [1, T]
+        # boolean algebra instead of where-on-i1: mosaic rejects a
+        # select with 1-bit operand values ("unsupported target
+        # bitwidth for truncation")
+        store_ok = (is_comm & (batp == lr_ref[:, :])) | (
+            ~is_comm & (abal_p >= ab)
+        )
+        ackp = elig_p & (batp != _V_NONE) & store_ok
+        candp = jnp.where(ackp & ~is_comm, abal_p, _B_NONE)
+        take = candp > best_b
+        best_b = jnp.where(take, candp, best_b)
+        best_v = jnp.where(take, jnp.broadcast_to(batp, best_v.shape), best_v)
+    do_store = best_b != _B_NONE
+    ab_out[:, :] = jnp.where(do_store, best_b, ab)
+    av_out[:, :] = jnp.where(do_store, best_v, av)
+
+
+def store_accepts(acc_ballot, acc_vid, learned, abat, abal, elig,
+                  interpret=False):
+    """Pallas twin of core/sim.py's _store_accepts body — called from
+    inside the (already-jitted) round, so no jit wrapper of its own;
+    input_output_aliases carries the in-place contract.
+
+    acc_ballot/acc_vid/learned [A, I], abat [P, I], abal [P] int32,
+    elig [P, A] bool.  Returns (acc_ballot', acc_vid') aliased in
+    place."""
+    a, i = acc_ballot.shape
+    p = abat.shape[0]
+    _check_aligned(i)
+    scals = jnp.concatenate(
+        [abal.astype(jnp.int32), elig.astype(jnp.int32).reshape(-1)]
+    )
+    tile = pl.BlockSpec((a, TILE), lambda t, s: (0, t))
+    ptile = pl.BlockSpec((p, TILE), lambda t, s: (0, t))
+    ab, av = pl.pallas_call(
+        _store_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(i // TILE,),
+            in_specs=[ptile, tile, tile, tile],
+            out_specs=[tile, tile],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((a, i), jnp.int32),
+            jax.ShapeDtypeStruct((a, i), jnp.int32),
+        ],
+        input_output_aliases={2: 0, 3: 1},  # acc_ballot, acc_vid in place
+        # (operand indices count the scalar-prefetch arg: scals=0,
+        # abat=1, acc_ballot=2, acc_vid=3, learned=4)
+        interpret=interpret,
+    )(scals, abat, acc_ballot, acc_vid, learned)
+    return ab, av
+
+
+def _ack_kernel(
+    scals_ref, acks_in, cb_ref, ab_ref, av_ref, lr_ref, acks_out, nack_ref
+):
+    """scals: [P] ballot then [P*A] amatch (int32 0/1, [P, A]
+    row-major)."""
+    p, a, _ = acks_in.shape
+    abv = ab_ref[:, :]
+    avv = av_ref[:, :]
+    lrv = lr_ref[:, :]
+    for pi in range(p):
+        ballot_p = scals_ref[pi]
+        am_p = jnp.stack(
+            [scals_ref[p + pi * a + ai] for ai in range(a)]
+        )[:, None] != 0  # [A, 1]
+        cb = cb_ref[pi, :][None, :]  # [1, T]
+        holdp = (avv == cb) & (abv == ballot_p)
+        commp = (lrv == cb) & (lrv != _V_NONE)
+        newa = acks_in[pi, :, :] | (
+            am_p & (cb != _V_NONE) & (holdp | commp)
+        ).astype(jnp.int8)
+        acks_out[pi, :, :] = newa
+        nack_ref[pi, :] = jnp.sum(newa.astype(jnp.int32), axis=0)
+
+
+def accum_acks(acks, cur_batch, acc_ballot, acc_vid, learned, ballot,
+               amatch_pa, interpret=False):
+    """Pallas twin of the ack-accumulation head of core/sim.py's
+    _accum_acks: returns (acks', n_ack), acks aliased in place.
+
+    acks [P, A, I] int8 (0/1 — i1 refs are i32-backed in mosaic,
+    which would 4x the cube traffic), cur_batch [P, I], acc_* /
+    learned [A, I], ballot [P] int32, amatch_pa [P, A] bool."""
+    p, a, i = acks.shape
+    _check_aligned(i)
+    scals = jnp.concatenate(
+        [ballot.astype(jnp.int32), amatch_pa.astype(jnp.int32).reshape(-1)]
+    )
+    cube = pl.BlockSpec((p, a, TILE), lambda t, s: (0, 0, t))
+    tile = pl.BlockSpec((a, TILE), lambda t, s: (0, t))
+    ptile = pl.BlockSpec((p, TILE), lambda t, s: (0, t))
+    acks2, n_ack = pl.pallas_call(
+        _ack_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(i // TILE,),
+            in_specs=[cube, ptile, tile, tile, tile],
+            out_specs=[cube, ptile],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((p, a, i), jnp.int8),
+            jax.ShapeDtypeStruct((p, i), jnp.int32),
+        ],
+        input_output_aliases={1: 0},  # acks in place
+        interpret=interpret,
+    )(scals, acks, cur_batch, acc_ballot, acc_vid, learned)
+    return acks2, n_ack
