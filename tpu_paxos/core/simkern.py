@@ -42,12 +42,15 @@ TILE = 32768
 
 
 def supported(n_instances: int, n_nodes: int = 5, n_proposers: int = 2) -> bool:
-    """The kernels require whole tiles AND the A/P envelope the TILE
-    sizing was budgeted for (the ack kernel's [P, A, TILE] cube plus
-    ~4 [A, TILE] refs must fit double-buffered VMEM); core/sim.py
-    falls back to the jnp path otherwise (and on every non-TPU
-    backend)."""
-    return n_instances % TILE == 0 and n_nodes <= 9 and n_proposers <= 9
+    """The kernels require whole tiles AND a geometry whose per-tile
+    working set fits VMEM double-buffered (the ack kernel dominates:
+    the [P, A, TILE] int8 cube in+out, three [A, TILE] int32 tiles,
+    and the [P, TILE] batch + count rows); core/sim.py falls back to
+    the jnp path otherwise (and on every non-TPU backend)."""
+    a, p = n_nodes, n_proposers
+    bytes_per_i = 2 * p * a + 3 * 4 * a + 3 * 4 * p  # ack-kernel refs
+    vmem_budget = 12 << 20  # of ~16 MiB scoped VMEM
+    return n_instances % TILE == 0 and 2 * TILE * bytes_per_i <= vmem_budget
 
 
 def _check_aligned(i: int) -> None:

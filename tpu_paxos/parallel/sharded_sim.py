@@ -95,6 +95,8 @@ def _state_specs(axes=INSTANCE_AXIS) -> simm.SimState:
         ),
         crashed=P(),
         done=P(),
+        qsums=P(),  # post-collective: replicated
+        qhmax=P(),
     )
 
 
