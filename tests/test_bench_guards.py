@@ -1,0 +1,96 @@
+"""The bench's artifact-proofing machinery (VERDICT r4 #1): the
+roofline guard must withhold physically impossible timings, the
+chosen-count check must be a real raise (not a strippable assert),
+and a non-converged median run must never publish an overstated
+value.  BENCH_r04 recorded a ~2000x timing artifact; these pin the
+defenses that keep one from ever landing in a BENCH file again."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+from tpu_paxos.config import SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.utils import prng
+
+
+def test_implausible_trips_on_impossible_bandwidth():
+    # 1 GiB of state traffic in 1 microsecond is ~1e15 B/s — far over
+    # any single chip
+    msg = bench._implausible(1 << 30, 1e-6)
+    assert msg is not None and "roofline" in msg
+
+
+def test_implausible_accepts_real_bandwidth():
+    # 1 GiB in 10 ms is ~107 GB/s — fine on a v5e
+    assert bench._implausible(1 << 30, 0.010) is None
+
+
+def test_implausible_scales_with_devices():
+    # 8 devices legitimately aggregate ~8x the bandwidth
+    n_bytes, dt = int(5e12), 1.0  # 5 TB/s implied
+    assert bench._implausible(n_bytes, dt, 1) is not None
+    assert bench._implausible(n_bytes, dt, 8) is None
+
+
+def test_check_total_raises_not_asserts():
+    with pytest.raises(RuntimeError, match="expected"):
+        bench._check_total(np.asarray([1, 2, 3], np.int32), 100)
+    bench._check_total(np.asarray([1, 2, 3], np.int32), 6)  # no raise
+
+
+def _mini_state(i):
+    cfg = SimConfig(n_nodes=3, n_instances=i, proposers=(0,))
+    wl = simm.default_workload(cfg)
+    pend, gate, tail, c = simm.prepare_queues(cfg, wl)
+    return simm.init_state(cfg, pend, gate, tail, prng.root_key(0))
+
+
+def test_timed_sim_runs_withholds_artifact_record():
+    """A lying timer (instant 'run' claiming 20k rounds of work) must
+    produce an error record with raw timings, not a throughput value
+    — the exact BENCH_r04 failure shape."""
+    i = 1 << 18
+    st0 = _mini_state(i)
+
+    def instant_go(root, st):
+        return st._replace(
+            t=jnp.int32(20_000),
+            done=jnp.bool_(True),
+            met=st.met._replace(
+                chosen_vid=jnp.zeros_like(st.met.chosen_vid)
+            ),
+        )
+
+    rec = bench._timed_sim_runs(
+        instant_go, lambda k: jnp.int32(k), st0, i, {"devices": 1}
+    )
+    assert "error" in rec and "roofline" in rec["error"]
+    assert "value" not in rec
+    assert len(rec["raw_timings_s"]) == 3
+
+
+def test_timed_sim_runs_withholds_nonconverged_value():
+    """If a timed run resolves less work than the warmup (done=False
+    at max_rounds), the record reports timings and counts but no
+    n_instances/dt value — which would overstate throughput."""
+    i = 1 << 16
+    st0 = _mini_state(i)
+
+    def flaky_go(root, st):
+        full = root == 3  # warmup seed converges; timed seeds don't
+        n = jnp.where(full, i, i // 2)
+        cv = jnp.where(jnp.arange(i) < n, 1, -1).astype(jnp.int32)
+        return st._replace(
+            t=jnp.int32(3),
+            done=full,
+            met=st.met._replace(chosen_vid=cv),
+        )
+
+    rec = bench._timed_sim_runs(
+        flaky_go, lambda k: jnp.int32(k), st0, i, {"devices": 1}
+    )
+    assert "error" in rec and "value" not in rec
+    assert rec["chosen_counts"]["warmup"] == i
+    assert all(c == i // 2 for c in rec["chosen_counts"]["timed"])

@@ -81,3 +81,27 @@ def test_cli_rejects_bad_fault_rate():
     assert p.returncode != 0
     err = (p.stderr + p.stdout).lower()
     assert "drop" in err or "rate" in err
+
+
+def test_cli_member_record_replay_roundtrip(tmp_path):
+    """--record-injections then --replay-injections: the replay's
+    decision-log hash must equal the recording run's (the reference's
+    member/run.sh record/replay + diff.sh workflow)."""
+    log = os.path.join(tmp_path, "inj.json")
+    rec = _run(
+        "3", "2", "3", "--seed=4", "--backend=cpu", "--engine=member",
+        "--json", f"--record-injections={log}",
+    )
+    assert rec.returncode == 0, rec.stderr[-2000:]
+    rec_js = json.loads(rec.stdout.strip().splitlines()[-1])
+    assert rec_js["ok"] and os.path.exists(log)
+
+    rep = _run(
+        "3", "2", "3", "--backend=cpu", "--engine=member", "--json",
+        f"--replay-injections={log}",
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    rep_js = json.loads(rep.stdout.strip().splitlines()[-1])
+    assert (
+        rep_js["decision_log_sha256"] == rec_js["decision_log_sha256"]
+    ), (rec_js, rep_js)

@@ -77,6 +77,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save-state", type=str, default="",
                    help="dump the run's decision tensors (chosen/learned/"
                    "metrics arrays) to this .npz path")
+    p.add_argument("--record-injections", type=str, default="",
+                   help="--engine=member: save the run's (round, op, args) "
+                   "host-injection log here for later replay (the "
+                   "reference's indet record pass, ref member/run.sh)")
+    p.add_argument("--replay-injections", type=str, default="",
+                   help="--engine=member: instead of running the churn "
+                   "scenario, re-execute a recorded injection log; the "
+                   "emitted decision-log hash must match the recording "
+                   "run's (the reference's replay + diff pass, ref "
+                   "member/run.sh:10-16, member/diff.sh)")
     return p
 
 
@@ -264,6 +274,43 @@ def _run_member_body(args) -> int:
     from tpu_paxos.utils import log as logm
 
     logger = logm.get_logger("cli", _level(args))
+    if args.replay_injections:
+        if args.record_injections:
+            raise SystemExit(
+                "--replay-injections and --record-injections are "
+                "mutually exclusive (replay re-executes an existing "
+                "log; it does not re-record)"
+            )
+        # replay pass: the engine re-derives everything from the
+        # recorded (seed, geometry, schedule) — positional geometry,
+        # --seed and --crash-rate on THIS command line are ignored in
+        # favor of the log's own parameters
+        logger.info(
+            "replaying %s: geometry/seed/crash-rate come from the log",
+            args.replay_injections,
+        )
+        sim = mem.MemberSim.replay(args.replay_injections)
+        _emit(args, {
+            "engine": "member",
+            "replayed_from": args.replay_injections,
+            "rounds": int(sim.state.t),
+            "injections": len(sim.injections),
+            "decision_log_sha256": _sha256(sim.decision_log()),
+            "ok": True,
+        })
+        return 0
+
+    def _member_emit(sim, payload: dict) -> None:
+        # the injection log saves on EVERY exit — a failing schedule
+        # is exactly the one worth replaying — and every member
+        # verdict carries the decision-log hash
+        if args.record_injections:
+            sim.save_injections(args.record_injections)
+            logger.info(
+                "injection log saved to %s", args.record_injections
+            )
+        payload["decision_log_sha256"] = _sha256(sim.decision_log())
+        _emit(args, payload)
     n = args.srvcnt
     nvals = args.cltcnt * args.idcnt
     sim = mem.MemberSim(n, n_instances=max(4 * (nvals + 4 * n), 64),
@@ -278,7 +325,7 @@ def _run_member_body(args) -> int:
             sim.propose(0, vid); vid += 1
         if not sim.run_until(lambda: sim.applied(cv), args.max_rounds):
             logger.error("add_acceptor(%d) never applied", tgt)
-            _emit(args, {"engine": "member", "ok": False})
+            _member_emit(sim, {"engine": "member", "ok": False})
             return 1
     # Propose via node 0 — the one node whose proposer role survives
     # the whole churn schedule (the reference's driver also proposes
@@ -297,7 +344,7 @@ def _run_member_body(args) -> int:
         cv = sim.del_acceptor(tgt)
         if not sim.run_until(lambda: sim.applied(cv), args.max_rounds):
             logger.error("del_acceptor(%d) never applied", tgt)
-            _emit(args, {"engine": "member", "ok": False})
+            _member_emit(sim, {"engine": "member", "ok": False})
             return 1
     # Drain: every proposed value applied at node 0 before the verdict.
     drained = sim.run_until(
@@ -326,7 +373,7 @@ def _run_member_body(args) -> int:
             args.save_state, sim.state, {"engine": "member", "seed": args.seed}
         )
         logger.info("member state saved to %s", args.save_state)
-    _emit(args, {
+    _member_emit(sim, {
         "engine": "member",
         "rounds": int(sim.state.t),
         "applied_node0": len(logs[0]),
@@ -335,6 +382,12 @@ def _run_member_body(args) -> int:
         "ok": ok,
     })
     return 0 if ok else 1
+
+
+def _sha256(text: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(text.encode()).hexdigest()[:16]
 
 
 def _level(args) -> int:
