@@ -249,64 +249,110 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
             st.max_seen,
             jnp.max(jnp.where(edge, st.ballot[:, None], bal.NONE), axis=0),
         )
-        is_comm = st.learned != val.NONE  # [I, A]
-        w_has = st.cur_batch != val.NONE  # [V, I]
-        ack = (
-            elig[:, None, :]
-            & w_has[:, :, None]
-            & jnp.where(
-                is_comm[None],
-                st.cur_batch[:, :, None] == st.learned[None],
-                st.ballot[:, None, None] >= st.acc_ballot[None],
-            )
-        )  # [V, I, A]
-        cand = jnp.where(
-            ack & ~is_comm[None], st.ballot[:, None, None], bal.NONE
-        )
-        best_b = jnp.max(cand, axis=0)  # [I, A]
-        best_v = jnp.argmax(cand, axis=0)
-        sel = rows[:, None, None] == best_v[None]
-        store_v = jnp.max(
-            jnp.where(sel, st.cur_batch[:, :, None], _NEG), axis=0
-        )
-        do_store = best_b != bal.NONE
-        acc_ballot = jnp.where(do_store, best_b, st.acc_ballot)
-        acc_vid = jnp.where(do_store, store_v, st.acc_vid)
         # rejects flow back synchronously
         rejed = edge & ~elig
         pmax = jnp.maximum(
             st.pmax, jnp.max(jnp.where(rejed.T, max_seen[:, None], bal.NONE).T, axis=1),
         )
 
-        # per-instance quorum over the proposer's view acceptors
-        acks = st.acks | ack
-        n_ack = jnp.sum(
-            acks & st.acceptors[:, None, :], axis=-1, dtype=jnp.int32
-        )  # [V, I]
-        # A crashed proposer can no longer detect (or broadcast) a
-        # choice even if its accumulated acks reach quorum; the value
-        # stays accepted-by-quorum until some live proposer re-prepares
-        # and adopts it.
-        inst_chosen = w_has & (n_ack >= quorum_v[:, None]) & alive[:, None]
-        newly = inst_chosen & (st.chosen_vid[None] == val.NONE)
-        any_new = jnp.any(newly, axis=0)
-        new_v = jnp.max(jnp.where(newly, st.cur_batch, _NEG), axis=0)
-        new_b = jnp.max(jnp.where(newly, st.ballot[:, None], _NEG), axis=0)
-        chosen_vid = jnp.where(any_new, new_v, st.chosen_vid)
-        chosen_round = jnp.where(any_new, t, st.chosen_round)
-        chosen_ballot = jnp.where(any_new, new_b, st.chosen_ballot)
+        # The [V, I, A]-cube work — stores, ack accumulation, quorum
+        # detection, learn broadcast — runs only while a prepared
+        # proposer has an open batch (the port of core/sim.py's
+        # event gating).  send_acc covers EVERY round the block can
+        # change anything: elig ⊆ edge ⊆ send_acc, and inst_chosen
+        # needs an open batch, which a cleared/unprepared proposer
+        # cannot have (cur_batch is NONE'd the round prepared drops) —
+        # so even the quorum-shrinks-under-an-accumulated-ack-set case
+        # stays inside the gate.  The proposer axis is unrolled into
+        # running elementwise maxes (exact: ballots are unique per
+        # node; chosen values agree per instance) instead of the old
+        # argmax + gather cubes.
+        any_acc = jnp.any(send_acc)
 
-        # LEARN broadcast (synchronous, to the chooser's view-learners;
-        # ref Learner::OnLearn) — chosen values reach every listed
-        # learner this round
-        learn_edge = (
-            inst_chosen[:, :, None]
-            & st.learners[:, None, :]
-            & alive[None, None, :]  # crashed learners learn nothing
+        def _accept_phase(acc_ballot, acc_vid, acks, cvid, cround, cballot,
+                          learned):
+            is_comm = learned != val.NONE  # [I, A]
+            best_b = jnp.full((i_cap, n), bal.NONE, jnp.int32)
+            best_v = jnp.full((i_cap, n), val.NONE, jnp.int32)
+            lbest = jnp.full((i_cap, n), _NEG, jnp.int32)
+            new_acks, n_ack_rows = [], []
+            w_has = st.cur_batch != val.NONE  # [V, I]
+            for v in range(n):
+                batv = st.cur_batch[v]  # [I]
+                ackv = (
+                    elig[v][None, :]
+                    & w_has[v][:, None]
+                    & jnp.where(
+                        is_comm,
+                        batv[:, None] == learned,
+                        st.ballot[v] >= acc_ballot,
+                    )
+                )  # [I, A]
+                candv = jnp.where(ackv & ~is_comm, st.ballot[v], bal.NONE)
+                take = candv > best_b
+                best_b = jnp.where(take, candv, best_b)
+                best_v = jnp.where(
+                    take, jnp.broadcast_to(batv[:, None], best_v.shape),
+                    best_v,
+                )
+                av_new = acks[v] | ackv
+                new_acks.append(av_new)
+                # per-instance quorum over the proposer's view acceptors
+                n_ack_rows.append(jnp.sum(
+                    av_new & st.acceptors[v][None, :], axis=-1,
+                    dtype=jnp.int32,
+                ))
+            acks = jnp.stack(new_acks)
+            n_ack = jnp.stack(n_ack_rows)  # [V, I]
+            do_store = best_b != bal.NONE
+            acc_ballot = jnp.where(do_store, best_b, acc_ballot)
+            acc_vid = jnp.where(do_store, best_v, acc_vid)
+            # A crashed proposer can no longer detect (or broadcast) a
+            # choice even if its accumulated acks reach quorum; the
+            # value stays accepted-by-quorum until some live proposer
+            # re-prepares and adopts it.
+            inst_chosen = (
+                w_has & (n_ack >= quorum_v[:, None]) & alive[:, None]
+            )
+            newly = inst_chosen & (cvid[None] == val.NONE)
+            any_new = jnp.any(newly, axis=0)
+            new_v = jnp.max(jnp.where(newly, st.cur_batch, _NEG), axis=0)
+            new_b = jnp.max(
+                jnp.where(newly, st.ballot[:, None], _NEG), axis=0
+            )
+            cvid = jnp.where(any_new, new_v, cvid)
+            cround = jnp.where(any_new, t, cround)
+            cballot = jnp.where(any_new, new_b, cballot)
+
+            # LEARN broadcast (synchronous, to the chooser's
+            # view-learners; ref Learner::OnLearn) — chosen values
+            # reach every listed learner this round
+            for v in range(n):
+                le_v = (
+                    inst_chosen[v][:, None]
+                    & st.learners[v][None, :]
+                    & alive[None, :]  # crashed learners learn nothing
+                )  # [I, L]
+                lbest = jnp.maximum(
+                    lbest,
+                    jnp.where(le_v, st.cur_batch[v][:, None], _NEG),
+                )
+            learned = jnp.where(
+                (lbest != _NEG) & (learned == val.NONE), lbest, learned
+            )
+            return (acc_ballot, acc_vid, acks, cvid, cround, cballot,
+                    learned, jnp.any(newly, axis=1))
+
+        (acc_ballot, acc_vid, acks, chosen_vid, chosen_round,
+         chosen_ballot, learned, newly_any) = jax.lax.cond(
+            any_acc,
+            _accept_phase,
+            lambda ab, av, ak, cv, cr, cb, lr: (
+                ab, av, ak, cv, cr, cb, lr, jnp.zeros((n,), jnp.bool_),
+            ),
+            st.acc_ballot, st.acc_vid, st.acks, st.chosen_vid,
+            st.chosen_round, st.chosen_ballot, st.learned,
         )
-        has_l = jnp.any(learn_edge, axis=0)  # [I, L]
-        lv = jnp.max(jnp.where(learn_edge, st.cur_batch[:, :, None], _NEG), axis=0)
-        learned = jnp.where(has_l & (st.learned == val.NONE), lv, st.learned)
 
         # anti-entropy pull at each node's first learned-gap (the
         # reference's learner-side Learn retry for unlearned instances,
@@ -394,7 +440,7 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
         prepared = st.prepared & ~acc_changed
 
         # batch staleness: no progress for too long -> restart prepare
-        progress = jnp.any(newly, axis=1)
+        progress = newly_any  # [N] from the gated accept phase
         outstanding = jnp.any(
             (st.cur_batch != val.NONE)
             & (chosen_vid[None] == val.NONE),
@@ -418,14 +464,29 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
             learned_me != st.own_assign
         )
         own_done = own_has & (learned_me == st.own_assign)
-        nreq = jnp.sum(conflict, axis=1, dtype=jnp.int32)
-        rr = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
-        req_pos = jnp.where(conflict, st.tail[:, None] + rr, c)
-        pend = st.pend.at[rows[:, None], req_pos].set(
-            st.own_assign, mode="drop"
+        # requeue cumsum + ring scatter only on conflict rounds; the
+        # own_assign clear only when something completed or conflicted
+        # (same gating core/sim.py uses)
+        any_conf = jnp.any(conflict)
+
+        def _requeue(pend, tail):
+            nreq = jnp.sum(conflict, axis=1, dtype=jnp.int32)
+            rr = jnp.cumsum(conflict.astype(jnp.int32), axis=1) - 1
+            req_pos = jnp.where(conflict, tail[:, None] + rr, c)
+            pend = pend.at[rows[:, None], req_pos].set(
+                st.own_assign, mode="drop"
+            )
+            return pend, tail + nreq
+
+        pend, tail = jax.lax.cond(
+            any_conf, _requeue, lambda pe, tl: (pe, tl), st.pend, st.tail
         )
-        tail = st.tail + nreq
-        own_assign = jnp.where(conflict | own_done, val.NONE, st.own_assign)
+        own_assign = jax.lax.cond(
+            jnp.any(conflict | own_done),
+            lambda oa: jnp.where(conflict | own_done, val.NONE, oa),
+            lambda oa: oa,
+            st.own_assign,
+        )
 
         # drop chosen instances from batches (quiesce bookkeeping)
         cur_batch = jnp.where(
@@ -581,23 +642,38 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
         batch_age = jnp.where(now_prep, 0, batch_age)
 
         # new-value assignment for prepared proposers (first-fit over
-        # the open tail; same shape as core/sim but ungated)
+        # the open tail; same shape as core/sim), gated on a prepared
+        # proposer actually having queue entries
         can_assign = prepared & alive
-        activity = (
-            committed_me | (cur_batch != val.NONE) | (own_assign != val.NONE)
+        has_q = can_assign & (tail > st.head)
+
+        def _assign(cur_batch, own_assign, head):
+            activity = (
+                committed_me
+                | (cur_batch != val.NONE)
+                | (own_assign != val.NONE)
+            )
+            hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)
+            free = idx[None] > hi2[:, None]
+            qn = jnp.minimum(tail - head, jnp.int32(i_cap))
+            free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+            kk = jnp.minimum(qn, jnp.sum(free, axis=1, dtype=jnp.int32))
+            kk = jnp.where(can_assign, kk, 0)
+            takev = free & (free_rank < kk[:, None])
+            qpos = jnp.clip(head[:, None] + free_rank, 0, c - 1)
+            newv = jnp.take_along_axis(pend, qpos, axis=1)
+            return (
+                jnp.where(takev, newv, cur_batch),
+                jnp.where(takev, newv, own_assign),
+                head + kk,
+            )
+
+        cur_batch, own_assign, head = jax.lax.cond(
+            jnp.any(has_q),
+            _assign,
+            lambda cb, oa, hd: (cb, oa, hd),
+            cur_batch, own_assign, st.head,
         )
-        hi2 = jnp.max(jnp.where(activity, idx[None], -1), axis=1)
-        free = idx[None] > hi2[:, None]
-        qn = jnp.minimum(tail - st.head, jnp.int32(i_cap))
-        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
-        kk = jnp.minimum(qn, jnp.sum(free, axis=1, dtype=jnp.int32))
-        kk = jnp.where(can_assign, kk, 0)
-        takev = free & (free_rank < kk[:, None])
-        qpos = jnp.clip(st.head[:, None] + free_rank, 0, c - 1)
-        newv = jnp.take_along_axis(pend, qpos, axis=1)
-        cur_batch = jnp.where(takev, newv, cur_batch)
-        own_assign = jnp.where(takev, newv, own_assign)
-        head = st.head + kk
 
         # ---------- crash injection ----------
         # Bernoulli(crash_rate/1e6) per live node per round (ref
