@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test-slow check bench bench-sharded parity parity-fast \
-	replay-diff replay-diff-member run stress clean
+	replay-diff replay-diff-member run stress stress-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -57,8 +57,16 @@ replay-diff-member:
 # Randomized sweep: seeds x fault mixes through the general engine,
 # full invariant suite on every run (the reference's stated purpose,
 # beyond the fixed-seed tests).  SEEDS=n overrides seeds per mix.
+# Failing seeds are shrunk to minimal repro artifacts under
+# stress-triage/ (`python -m tpu_paxos repro <artifact>` replays them).
 stress:
-	$(PY) -m tpu_paxos.harness.stress --seeds $(or $(SEEDS),8) --sharded
+	$(PY) -m tpu_paxos.harness.stress --seeds $(or $(SEEDS),8) --sharded \
+	  --triage-dir stress-triage
+
+# Quick pass: 2 seeds x every mix (incl. the correlated-fault episode
+# mixes: partition-flap, one-way, pause-heavy, pause-crash).
+stress-quick:
+	$(PY) -m tpu_paxos.harness.stress --seeds 2 --triage-dir stress-triage
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

@@ -8,8 +8,18 @@ platform must be switched through jax.config before any backend
 initialization (first device/array use).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the option landed after 0.4.x; the XLA flag does the
+    # same provisioning as long as the backend is not initialized yet
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 jax.config.update("jax_threefry_partitionable", True)

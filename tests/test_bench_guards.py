@@ -94,3 +94,35 @@ def test_timed_sim_runs_withholds_nonconverged_value():
     assert "error" in rec and "value" not in rec
     assert rec["chosen_counts"]["warmup"] == i
     assert all(c == i // 2 for c in rec["chosen_counts"]["timed"])
+
+
+def test_guard_headline_publishes_measured_rate():
+    # 1 GiB state, 10 ms median: plausible — median rate published
+    rate, upper, note = bench._guard_headline(
+        [0.010, 0.011, 0.012], 1 << 30, 1, 1000
+    )
+    assert rate == pytest.approx(1000 / 0.011)
+    assert upper is None and note is None
+
+
+def test_guard_headline_falls_back_to_slowest():
+    # median implausible, slowest fine: slowest-timing rate, noted
+    rate, upper, note = bench._guard_headline(
+        [1e-6, 1e-6, 0.010], 1 << 30, 1, 1000
+    )
+    assert rate == pytest.approx(1000 / 0.010)
+    assert upper is None and "slowest" in note
+
+
+def test_guard_headline_withholds_when_all_implausible():
+    """ADVICE round 5: a roofline-synthesized number must never be
+    published as `value` — it moves to value_upper_bound and the value
+    is withheld."""
+    rate, upper, note = bench._guard_headline(
+        [1e-6, 2e-6, 3e-6], 1 << 30, 1, 1000
+    )
+    assert rate is None
+    assert upper == pytest.approx(
+        1000 / ((1 << 30) / bench.ROOFLINE_BYTES_PER_SEC)
+    )
+    assert "withheld" in note and "value_upper_bound" in note

@@ -91,3 +91,42 @@ def test_member_state_roundtrip_mid_churn(tmp_path):
     ms2.state, _ = checkpoint.restore(path, ms2.state)
     assert ms2.run_until(lambda: ms2.applied(cv), max_rounds=400)
     assert ms2.acceptor_set(0) == {0, 1}
+
+
+def test_checkpoint_carries_format_version(tmp_path):
+    """Every checkpoint records the format string; a stale-format file
+    is named as such in the mismatch error (distinguishable from a
+    wrong geometry), and an unversioned one is called out too
+    (ADVICE round 5)."""
+    import json
+
+    import numpy as np
+
+    cfg = SimConfig(n_nodes=3, n_instances=32, proposers=(0,), seed=0)
+    _, pend, gate, tail, c, root, state, _ = _setup(cfg)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint.save(path, state, meta={"k": 1})
+    restored, meta = checkpoint.restore(path, state)
+    assert meta["format"] == checkpoint.FORMAT and meta["k"] == 1
+
+    # forge a checkpoint from a different format era with a different
+    # leaf set: the error must name both format strings
+    with np.load(path) as z:
+        payload = {k: z[k] for k in z.files if k.startswith("leaf_")}
+    payload.pop("leaf_0")
+    payload["tpu_paxos_meta"] = np.frombuffer(
+        json.dumps({"format": "tpu-paxos-ckpt-v1"}).encode(), dtype=np.uint8
+    )
+    stale = str(tmp_path / "stale.npz")
+    np.savez(stale, **payload)
+    with pytest.raises(ValueError, match="tpu-paxos-ckpt-v1.*!= current"):
+        checkpoint.restore(stale, state)
+
+    # unversioned (pre-format) checkpoints are named explicitly
+    payload["tpu_paxos_meta"] = np.frombuffer(
+        json.dumps({}).encode(), dtype=np.uint8
+    )
+    unver = str(tmp_path / "unversioned.npz")
+    np.savez(unver, **payload)
+    with pytest.raises(ValueError, match="unversioned"):
+        checkpoint.restore(unver, state)

@@ -127,3 +127,37 @@ def test_crash_rejoin_replays_bit_identically(tmp_path):
     ms.save_injections(inj)
     ms2 = MemberSim.replay(inj)
     assert ms2.decision_log() == ms.decision_log()
+
+
+def test_replay_verifies_rejoin_checkpoint_integrity(tmp_path):
+    """The injection log pins the rejoin checkpoint's sha256 +
+    geometry at record time (ADVICE round 5): a rewritten file makes
+    replay fail loudly with the hash named; a missing file names the
+    path — never a silent divergence from the recorded run."""
+    import json
+
+    ms = MemberSim(n_nodes=5, n_instances=48, seed=4)
+    _grow_to(ms, (1, 2))
+    ms.propose(0, 100)
+    assert ms.run_until(lambda: ms.chosen(100))
+    ms.crash(2)
+    ck = os.path.join(tmp_path, "n2.npz")
+    checkpoint.save(ck, ms.state)
+    ms.rejoin_from_checkpoint(2, ck)
+    inj = os.path.join(tmp_path, "inj.json")
+    ms.save_injections(inj)
+
+    # the recorded log carries the integrity record
+    ops = json.load(open(inj))["ops"]
+    rejoin = [o for o in ops if o[1] == "rejoin"][0]
+    assert rejoin[2][2]["sha256"] and rejoin[2][2]["n_nodes"] == 5
+
+    # tamper: replace the checkpoint with a different (valid) snapshot
+    checkpoint.save(ck, ms.state, meta={"tampered": True})
+    with pytest.raises(ValueError, match="sha256"):
+        MemberSim.replay(inj)
+
+    # missing file names the path
+    os.remove(ck)
+    with pytest.raises(ValueError, match="missing"):
+        MemberSim.replay(inj)

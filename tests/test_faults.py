@@ -1,0 +1,298 @@
+"""Correlated fault schedules (core/faults.py) through both engines.
+
+Covers: schedule compilation semantics, the heal-then-converge
+liveness contract (quiescence gated on the last heal; paused nodes
+owed — not excused — after resume), partition / one-way / pause /
+burst behavior under the general engine, schedule determinism, the
+membership engine under episodes (incl. record/replay), and the
+dense-vs-sharded byte-identical decision log on an episode mix."""
+
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import sim
+from tpu_paxos.core import values as val
+from tpu_paxos.harness import validate
+
+
+def _cfg(sched, seed=0, n_nodes=5, n_instances=64, drop=300, **kw):
+    return SimConfig(
+        n_nodes=n_nodes,
+        n_instances=n_instances,
+        proposers=(0, 1),
+        seed=seed,
+        faults=FaultConfig(
+            drop_rate=drop, dup_rate=500, max_delay=2, schedule=sched, **kw
+        ),
+    )
+
+
+# ---------------- compilation ----------------
+
+def test_compile_schedule_tables():
+    sched = flt.FaultSchedule((
+        flt.partition(2, 5, (0, 1), (2, 3, 4)),
+        flt.one_way(3, 7, (0,), (2,)),
+        flt.pause(4, 9, 1),
+        flt.burst(1, 4, 2000),
+    ))
+    c = flt.compile_schedule(sched, 5)
+    assert c.horizon == 9 and c.reach.shape == (10, 5, 5)
+    # partition window: groups mutually cut, both directions
+    assert not c.reach[2, 0, 2] and not c.reach[2, 2, 0]
+    assert c.reach[2, 0, 1] and c.reach[2, 2, 3]
+    # one_way: only src->dst cut
+    assert not c.reach[6, 0, 2] and c.reach[6, 2, 0]
+    # self-reachability survives any cut
+    assert c.reach[2].diagonal().all()
+    # healed row
+    assert c.reach[9].all() and not c.paused[9].any()
+    assert c.paused[4, 1] and not c.paused[3, 1]
+    assert c.extra_drop[1] == 2000 and c.extra_drop[4] == 0
+
+
+def test_compile_rejects_out_of_range_nodes():
+    sched = flt.FaultSchedule((flt.pause(0, 4, 7),))
+    with pytest.raises(ValueError, match="node 7"):
+        flt.compile_schedule(sched, 5)
+
+
+def test_episode_validation():
+    with pytest.raises(ValueError, match="non-empty"):
+        flt.pause(5, 5, 1)
+    with pytest.raises(ValueError, match="disjoint"):
+        flt.partition(0, 4, (0, 1), (1, 2))
+    with pytest.raises(ValueError, match="non-empty"):
+        flt.partition(0, 4)
+    with pytest.raises(ValueError, match="drop_rate"):
+        flt.burst(0, 4, 0)
+    # one group listing EVERY node cuts nothing — compile-time error
+    with pytest.raises(ValueError, match="nothing is cut"):
+        flt.compile_schedule(
+            flt.FaultSchedule((flt.partition(0, 4, (0, 1, 2)),)), 3
+        )
+
+
+def test_partition_single_group_uses_implicit_complement():
+    """The documented shorthand: partition(t0, t1, (0, 1)) isolates
+    {0, 1} from the implicit complement group."""
+    c = flt.compile_schedule(
+        flt.FaultSchedule((flt.partition(0, 2, (0, 1)),)), 5
+    )
+    assert not c.reach[0, 0, 2] and not c.reach[0, 3, 1]
+    assert c.reach[0, 0, 1] and c.reach[0, 2, 4]
+
+
+def test_partition_unlisted_nodes_form_implicit_group():
+    c = flt.compile_schedule(
+        flt.FaultSchedule((flt.partition(0, 2, (0,), (1,)),)), 4
+    )
+    # 2 and 3 are unlisted: together, cut from both listed groups
+    assert c.reach[0, 2, 3] and c.reach[0, 3, 2]
+    assert not c.reach[0, 0, 2] and not c.reach[0, 1, 3]
+
+
+def test_schedule_json_roundtrip():
+    sched = flt.FaultSchedule((
+        flt.partition(1, 9, (0, 2), (1, 3)),
+        flt.one_way(2, 5, (1,), (0, 3)),
+        flt.pause(3, 6, 2),
+        flt.burst(0, 2, 111),
+    ))
+    assert flt.FaultSchedule.from_dict(sched.to_dict()) == sched
+
+
+def test_round_budget_extends_past_horizon():
+    sched = flt.FaultSchedule((flt.pause(10, 500, 1),))
+    cfg = _cfg(sched)
+    assert cfg.round_budget == cfg.max_rounds + 500
+    assert _cfg(None).round_budget == _cfg(None).max_rounds
+
+
+# ---------------- general engine ----------------
+
+def test_partition_heals_and_converges():
+    """A partition that strands both proposers away from quorum wedges
+    progress during the window; after the heal every invariant holds
+    and quiescence is declared at/after the horizon."""
+    sched = flt.FaultSchedule((
+        flt.partition(4, 40, (0, 1), (2, 3, 4)),
+    ))
+    r = sim.run(_cfg(sched, seed=3))
+    assert r.done
+    assert r.rounds >= 40  # done is gated on the last heal
+    validate.check_all(r.learned, r.expected_vids)
+
+
+def test_pause_is_not_a_crash():
+    """A paused node resumes and is owed the full log: its learner
+    column must be complete at quiescence (a crashed node's would be
+    excused), and it must never be reported crashed."""
+    sched = flt.FaultSchedule((flt.pause(3, 30, 2),))
+    r = sim.run(_cfg(sched, seed=1))
+    assert r.done and not r.crashed.any()
+    validate.check_all(r.learned, r.expected_vids)
+    # node 2's learner column has no holes below the frontier
+    hmax = int(np.max(np.flatnonzero(r.chosen_vid != int(val.NONE))))
+    assert (r.learned[: hmax + 1, 2] != int(val.NONE)).all()
+
+
+def test_one_way_cut_and_burst():
+    sched = flt.FaultSchedule((
+        flt.one_way(2, 25, (0,), (2, 3)),
+        flt.burst(5, 20, 4000),
+    ))
+    r = sim.run(_cfg(sched, seed=5))
+    assert r.done
+    validate.check_all(r.learned, r.expected_vids)
+
+
+def test_paused_proposer_values_still_chosen():
+    """Proposer node 1 pauses with an undrained queue: its values must
+    still be chosen after the heal (no crash-style liveness waiver),
+    and no no-op may squat on the space they need."""
+    sched = flt.FaultSchedule((flt.pause(2, 36, 1),))
+    r = sim.run(_cfg(sched, seed=2))
+    assert r.done
+    validate.check_all(r.learned, r.expected_vids)
+
+
+@pytest.mark.slow
+def test_schedule_determinism():
+    sched = flt.FaultSchedule((
+        flt.partition(4, 20, (0, 3), (1, 2, 4)),
+        flt.pause(24, 40, 2),
+    ))
+    a = sim.run(_cfg(sched, seed=9))
+    b = sim.run(_cfg(sched, seed=9))
+    assert np.array_equal(a.chosen_vid, b.chosen_vid)
+    assert np.array_equal(a.chosen_round, b.chosen_round)
+    assert np.array_equal(a.learned, b.learned)
+
+
+@pytest.mark.slow
+def test_gate_chains_across_partition_flaps():
+    """In-order gate chains survive a flapping-partition schedule."""
+    sched = flt.FaultSchedule((
+        flt.partition(5, 25, (0, 1), (2, 3, 4)),
+        flt.partition(35, 55, (0, 2, 4), (1, 3)),
+    ))
+    chain = np.asarray([10, 11, 12, 13], np.int32)
+    gates = [
+        np.asarray([int(val.NONE), 10, 11, 12], np.int32),
+        np.zeros((0,), np.int32),
+    ]
+    free = np.arange(100, 120, dtype=np.int32)
+    r = sim.run(_cfg(sched, seed=4, n_instances=128),
+                workload=[chain, free], gates=gates)
+    assert r.done
+    seqs = validate.check_all(r.learned, np.concatenate([chain, free]))
+    validate.check_in_order_clients(max(seqs, key=len), [chain])
+
+
+# ---------------- dense vs sharded ----------------
+
+def test_dense_vs_sharded_byte_identical_on_episode_mix():
+    """Same seed + same schedule => byte-identical decision logs
+    between the dense engine and the sharded engine on a single-shard
+    mesh (the sharded code path — shard_map, collectives, axis-index
+    globalization — with placement-identical geometry)."""
+    from tpu_paxos.parallel import mesh as pmesh
+    from tpu_paxos.parallel import sharded_sim
+    from tpu_paxos.replay.decision_log import decision_log
+
+    sched = flt.FaultSchedule((
+        flt.partition(4, 22, (0, 1), (2, 3, 4)),
+        flt.pause(26, 40, 3),
+        flt.burst(8, 16, 2000),
+    ))
+    cfg = _cfg(sched, seed=6, n_instances=64)
+    dense = sim.run(cfg)
+    m1 = pmesh.make_instance_mesh(1)
+    assert m1.size == 1
+    sharded = sharded_sim.run_sharded(cfg, m1)
+    assert dense.done and sharded.done
+
+    def render(r):
+        return decision_log(
+            r.chosen_vid, r.chosen_ballot, stride=1 << 20,
+            n_instances=cfg.n_instances,
+        )
+
+    assert render(dense) == render(sharded)
+    assert np.array_equal(dense.chosen_round, sharded.chosen_round)
+    assert np.array_equal(dense.learned, sharded.learned)
+
+
+@pytest.mark.slow
+def test_sharded_episode_mix_multiset_equality():
+    """8-shard run under a schedule: placement differs by design, the
+    chosen-value multiset and every invariant must not."""
+    from tpu_paxos.parallel import mesh as pmesh
+    from tpu_paxos.parallel import sharded_sim
+
+    sched = flt.FaultSchedule((
+        flt.partition(4, 24, (0, 2), (1, 3, 4)),
+        flt.pause(28, 44, 1),
+    ))
+    cfg = SimConfig(
+        n_nodes=5, n_instances=256, proposers=(0, 1), seed=7,
+        faults=FaultConfig(drop_rate=300, dup_rate=500, max_delay=2,
+                           schedule=sched),
+    )
+    m = pmesh.make_instance_mesh()
+    r = sharded_sim.run_sharded(cfg, m)
+    assert r.done
+    validate.check_agreement(r.learned)
+    validate.check_exactly_once(r.learned, r.expected_vids)
+    r1 = sim.run(cfg)
+    real = lambda cv: sorted(v for v in np.asarray(cv).tolist() if v >= 0)  # noqa: E731
+    assert real(r.chosen_vid) == real(r1.chosen_vid)
+
+
+# ---------------- membership engine ----------------
+
+def test_member_engine_under_pause_and_partition():
+    """Churn + proposals with a pause and a partition episode: prefix
+    consistency holds and everything applies after the heal."""
+    from tpu_paxos.membership import engine as mem
+
+    sched = flt.FaultSchedule((
+        flt.pause(6, 20, 2),
+        flt.partition(24, 40, (0, 1), (2, 3)),
+    ))
+    ms = mem.MemberSim(4, n_instances=64, seed=0, schedule=sched)
+    for tgt in (1, 2, 3):
+        cv = ms.add_acceptor(tgt)
+        assert ms.run_until(lambda: ms.applied(cv), 2000)
+    for v in range(6):
+        ms.propose(0, v)
+        ms.run_rounds(2)
+    assert ms.run_until(
+        lambda: set(range(6)) <= set(ms.applied_log(0).tolist()), 2000
+    )
+    validate.check_prefix_consistency(
+        [ms.applied_log(a) for a in range(4)]
+    )
+
+
+def test_member_schedule_record_replay_byte_identical(tmp_path):
+    """The schedule is part of the recorded identity: replay re-derives
+    the same decision log byte-for-byte."""
+    from tpu_paxos.membership import engine as mem
+
+    sched = flt.FaultSchedule((flt.pause(4, 14, 1),))
+    ms = mem.MemberSim(3, n_instances=48, seed=5, schedule=sched)
+    cv = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(cv), 2000)
+    for v in range(4):
+        ms.propose(0, v)
+        ms.run_rounds(3)
+    ms.run_rounds(20)
+    path = tmp_path / "inj.json"
+    ms.save_injections(path)
+    replayed = mem.MemberSim.replay(path)
+    assert replayed.decision_log() == ms.decision_log()
+    assert replayed.schedule == sched

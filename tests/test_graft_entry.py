@@ -37,7 +37,10 @@ def test_dryrun_multichip_with_jax_preinitialized_small():
     code = (
         "import jax\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
-        "jax.config.update('jax_num_cpu_devices', 1)\n"
+        "try:\n"
+        "    jax.config.update('jax_num_cpu_devices', 1)\n"
+        "except AttributeError:\n"
+        "    pass\n"  # pre-0.5 jax: 1 CPU device is the default anyway
         "assert len(jax.devices()) == 1\n"  # backend initialized, 1 device
         "import __graft_entry__ as g\n"
         "g.dryrun_multichip(8)\n"

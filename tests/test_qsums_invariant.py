@@ -1,0 +1,108 @@
+"""Event-coverage invariant for the cached quiescence counts
+(ADVICE round 5, core/sim.py q_change).
+
+Correctness of the quiet-round skip rests on a hand-enumerated event
+list covering every mutation of the counted arrays
+(chosen/learned/cur_batch/own_assign/head/tail).  This test pins the
+invariant at runtime: step the engine round by round and recompute
+the counts unconditionally from the post-round state — the cached
+``qsums``/``qhmax`` must match EVERY round, not just on measured
+ones.  A future edit that writes a counted array outside the listed
+conds shows up here as a drift on the first quiet round after it."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import sim as simm
+from tpu_paxos.utils import prng
+
+NONE = -1
+
+
+def _expected_counts(st, n_instances):
+    chosen = np.asarray(st.met.chosen_vid)
+    learned = np.asarray(st.learned)  # [A, I]
+    cur_batch = np.asarray(st.prop.cur_batch)
+    own = np.asarray(st.prop.own_assign)
+    head = np.asarray(st.prop.head)
+    tail = np.asarray(st.prop.tail)
+    inflight = (cur_batch != NONE) & (chosen[None] == NONE)
+    sums = np.concatenate([
+        [np.sum(chosen != NONE)],
+        (learned != NONE).sum(axis=1),
+        inflight.sum(axis=1),
+        (head != tail).astype(np.int64),
+        (own != NONE).sum(axis=1),
+    ]).astype(np.int32)
+    idx = np.arange(n_instances)
+    hmax = int(np.where(chosen != NONE, idx, -1).max())
+    return sums, hmax
+
+
+def _check_run(cfg, max_rounds=600):
+    pend, gate, tail, c = simm.prepare_queues(cfg, simm.default_workload(cfg))
+    root = prng.root_key(cfg.seed)
+    st = simm.init_state(cfg, pend, gate, tail, root)
+    round_fn = jax.jit(simm.build_engine(cfg, c, vid_cap=0))
+    rounds = 0
+    while not bool(st.done) and rounds < min(cfg.round_budget, max_rounds):
+        st = round_fn(root, st)
+        rounds += 1
+        sums, hmax = _expected_counts(st, cfg.n_instances)
+        got = np.asarray(st.qsums)
+        assert np.array_equal(got, sums), (
+            f"round {rounds}: cached qsums {got.tolist()} != "
+            f"recomputed {sums.tolist()}"
+        )
+        assert int(st.qhmax) == hmax, (
+            f"round {rounds}: cached qhmax {int(st.qhmax)} != {hmax}"
+        )
+    assert bool(st.done), f"no quiescence in {rounds} rounds"
+
+
+def test_qsums_match_under_iid_faults():
+    """debug.conf-rate faults, no crashes: the cache path (not the
+    every-round crash fallback) must stay exactly current."""
+    cfg = SimConfig(
+        n_nodes=5, n_instances=48, proposers=(0, 1), seed=11,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+    _check_run(cfg)
+
+
+def test_qsums_match_under_episode_schedule():
+    """Same assertion through a partition + pause + burst schedule:
+    episode masking must not open an un-enumerated mutation path."""
+    sched = flt.FaultSchedule((
+        flt.partition(4, 20, (0, 1), (2, 3, 4)),
+        flt.pause(24, 40, 2),
+        flt.burst(8, 16, 2500),
+    ))
+    cfg = SimConfig(
+        n_nodes=5, n_instances=48, proposers=(0, 1), seed=3,
+        faults=FaultConfig(drop_rate=300, dup_rate=500, max_delay=2,
+                           schedule=sched),
+    )
+    _check_run(cfg)
+
+
+@pytest.mark.slow
+def test_qsums_match_multi_seed_faulty():
+    """Multi-seed sweep of the invariant, i.i.d. and episode mixes."""
+    sched = flt.FaultSchedule((
+        flt.partition(6, 26, (0, 2), (1, 3, 4)),
+        flt.pause(30, 46, 1),
+    ))
+    for seed in range(4):
+        for schedule in (None, sched):
+            cfg = SimConfig(
+                n_nodes=5, n_instances=48, proposers=(0, 1), seed=seed,
+                faults=FaultConfig(
+                    drop_rate=700, dup_rate=1000, max_delay=3,
+                    schedule=schedule,
+                ),
+            )
+            _check_run(cfg, max_rounds=1500)

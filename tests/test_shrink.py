@@ -1,0 +1,139 @@
+"""Failure triage (harness/shrink.py): greedy schedule shrinking and
+the one-command repro artifact.
+
+The deliberately-broken invariant is the artifact-recorded
+``decision_round_max`` hook: a partition episode delays decisions past
+a tight bound, so the hook fails exactly when the partition is present
+— the shrinker must keep the partition, drop the irrelevant episodes,
+and the written artifact must reproduce the identical violation with
+a byte-identical decision log (sha256), twice, through
+``python -m tpu_paxos repro``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
+from tpu_paxos.harness import shrink as shr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _case(extra_checks, sched, seed=7, max_rounds=4000):
+    wl = [
+        np.arange(100, 110, dtype=np.int32),
+        np.arange(200, 210, dtype=np.int32),
+    ]
+    cfg = SimConfig(
+        n_nodes=5, n_instances=64, proposers=(0, 1), seed=seed,
+        max_rounds=max_rounds,
+        faults=FaultConfig(drop_rate=300, dup_rate=500, max_delay=2,
+                           schedule=sched),
+    )
+    return shr.ReproCase(
+        cfg=cfg, workload=wl, gates=None,
+        chains=[np.zeros(0, np.int32)] * 2,
+        extra_checks=extra_checks,
+    )
+
+
+def test_green_case_has_no_violation_and_refuses_shrink():
+    case = _case({}, None)
+    _, v = shr.run_case(case)
+    assert v is None
+    with pytest.raises(ValueError, match="does not fail"):
+        shr.shrink_case(case)
+
+
+def test_artifact_roundtrip_and_reproduce(tmp_path):
+    """Save -> load -> reproduce: identical violation, stable sha,
+    match=True — without shrinking (3 engine runs, fast tier)."""
+    sched = flt.FaultSchedule((flt.partition(5, 35, (0, 1), (2, 3, 4)),))
+    case = _case({"decision_round_max": 25}, sched)
+    _, viol = shr.run_case(case)
+    assert viol and "decision_round_max" in viol
+    path = str(tmp_path / "repro.json")
+    art = shr.save_artifact(path, case, viol)
+    assert art["format"] == shr.ARTIFACT_FORMAT
+    loaded, art2 = shr.load_artifact(path)
+    assert loaded.cfg == case.cfg
+    assert art2["violation"] == viol
+    rep = shr.reproduce(path)
+    assert rep["match"], rep
+    assert rep["violation"] == viol
+
+
+def test_artifact_rejects_unknown_format(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"format": "something-else"}))
+    with pytest.raises(ValueError, match="format"):
+        shr.load_artifact(str(p))
+
+
+@pytest.mark.slow
+def test_shrinker_isolates_culprit_episode(tmp_path):
+    """Three episodes, one culprit: the shrinker must drop the two
+    irrelevant ones, narrow the partition, and the artifact must
+    reproduce twice via the CLI with byte-identical stdout."""
+    sched = flt.FaultSchedule((
+        flt.partition(5, 45, (0, 1), (2, 3, 4)),  # the culprit
+        flt.pause(50, 60, 3),  # irrelevant: after all decisions
+        flt.burst(2, 8, 1500),  # irrelevant: too short to matter
+    ))
+    case = _case({"decision_round_max": 40}, sched)
+    small, viol = shr.shrink_case(case, max_evals=40)
+    eps = small.cfg.faults.schedule.episodes
+    assert [e.kind for e in eps] == ["partition"]
+    # the interval was narrowed (bisection trims the tail)
+    assert eps[0].t1 - eps[0].t0 < 40
+    path = str(tmp_path / "repro.json")
+    shr.save_artifact(path, small, viol)
+
+    def run_cli():
+        return subprocess.run(
+            [sys.executable, "-m", "tpu_paxos", "repro", path, "--json"],
+            capture_output=True, text=True, cwd=REPO, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    p1, p2 = run_cli(), run_cli()
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    # byte-identical stdout: decision log + JSON verdict
+    assert p1.stdout == p2.stdout
+    verdict = json.loads(p1.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["violation"] == viol
+
+
+@pytest.mark.slow
+def test_sweep_triage_writes_artifact_on_failure(tmp_path, monkeypatch):
+    """A stress sweep with a failure-injecting validator writes a repro
+    artifact and records its path in the failure entry."""
+    from tpu_paxos.harness import stress, validate
+
+    def broken(r, cfg, workload, chains):
+        raise validate.InvariantViolation("injected: always fails")
+
+    monkeypatch.setattr(stress, "_validate_run", broken)
+    monkeypatch.setattr(stress, "MIXES", [stress.MIXES[1]])
+    # the shrinker judges candidates with the REAL suite (shr.validate_run
+    # is untouched), so candidate runs are green and the case itself
+    # 'fails' only under the injected validator — triage must degrade
+    # gracefully: the failure is recorded with a triage_error, never
+    # masked.  Then check the genuine path: a real extra-check failure
+    # produces an artifact directly through shr.triage.
+    summary = stress.sweep(
+        n_seeds=1, verbose=False, triage_dir=str(tmp_path)
+    )
+    assert not summary["ok"]
+    assert summary["failures"][0]["error"].startswith("injected")
+    sched = flt.FaultSchedule((flt.partition(5, 45, (0, 1), (2, 3, 4)),))
+    case = _case({"decision_round_max": 40}, sched)
+    art = shr.triage(case, str(tmp_path / "direct.json"), max_evals=20)
+    assert os.path.exists(tmp_path / "direct.json")
+    rep = shr.reproduce(str(tmp_path / "direct.json"))
+    assert rep["match"] and rep["violation"] == art["violation"]

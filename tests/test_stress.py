@@ -1,4 +1,5 @@
-"""Smoke the randomized stress sweep (full sweep is `make stress`)."""
+"""Smoke the randomized stress sweep (full sweep is `make stress`,
+2-seed quick pass is `make stress-quick`)."""
 
 from tpu_paxos.harness import stress
 import pytest
@@ -14,3 +15,15 @@ def test_stress_sweep_smoke(monkeypatch):
     summary = stress.sweep(n_seeds=1, verbose=False)
     assert summary["ok"], summary["failures"]
     assert summary["runs"] == 2
+
+
+@pytest.mark.slow
+def test_stress_sweep_episode_mixes_smoke(monkeypatch):
+    """The correlated-fault mixes (partition-flap / one-way /
+    pause-heavy / pause-crash), two seeds each — the `make
+    stress-quick` shape, so the episode schedules and their
+    heal-then-converge contract are exercised by `pytest -m slow`."""
+    monkeypatch.setattr(stress, "MIXES", list(stress.EPISODE_MIXES))
+    summary = stress.sweep(n_seeds=2, verbose=False)
+    assert summary["ok"], summary["failures"]
+    assert summary["runs"] == 2 * len(stress.EPISODE_MIXES)

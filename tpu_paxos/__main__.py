@@ -13,6 +13,13 @@ Output: the decision log in the reference grammar
 (ref multi/paxos.cpp:18-22) on stdout, then an invariant verdict line
 — the same checks as the reference epilogue (ref multi/main.cpp:566-573).
 Exit code 0 iff every invariant holds.
+
+``python -m tpu_paxos repro <artifact.json>`` is the failure-triage
+entry point: it re-executes a shrunk repro artifact written by the
+stress sweep (harness/shrink.py), prints the decision log, and exits
+0 iff the recorded violation recurs with a byte-identical decision
+log (sha256 compare — the member/diff.sh workflow for the general
+engine).
 """
 
 from __future__ import annotations
@@ -101,7 +108,13 @@ def _select_backend(backend: str, mesh: int = 0) -> None:
         if backend == "cpu" and mesh > 1:
             # provision enough virtual CPU devices for the requested
             # mesh (a dev box has one CPU device by default)
-            jax.config.update("jax_num_cpu_devices", mesh)
+            try:
+                jax.config.update("jax_num_cpu_devices", mesh)
+            except AttributeError:  # pre-0.5 jax: use the XLA flag
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={mesh}"
+                )
     except RuntimeError:
         pass  # backend already initialized; env var did its best
 
@@ -440,7 +453,51 @@ def _emit(args, summary: dict) -> None:
         print(f"[{summary.get('engine')}] {status} ({detail})")
 
 
+def run_repro(argv) -> int:
+    """``python -m tpu_paxos repro <artifact>`` — re-execute a shrunk
+    repro artifact and verify it reproduces: identical violation,
+    byte-identical decision log (sha256)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_paxos repro",
+        description="replay a stress-triage repro artifact",
+    )
+    ap.add_argument("artifact", help="path to a repro .json "
+                    "(written by the stress sweep's --triage-dir)")
+    ap.add_argument("--backend", choices=("tpu", "cpu", "auto"),
+                    default="auto")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON summary instead of the verdict line")
+    ap.add_argument("--log-level", type=str, default="INFO")
+    args = ap.parse_args(argv)
+    _select_backend(args.backend)
+    from tpu_paxos.harness import shrink as shr
+    from tpu_paxos.utils import log as logm
+
+    logger = logm.get_logger("repro", _level(args))
+    rep = shr.reproduce(args.artifact)
+    sys.stdout.write(rep.pop("decision_log"))
+    if rep["match"]:
+        logger.info(
+            "reproduced: %s (decision log sha256 %s)",
+            rep["violation"], rep["decision_log_sha256"][:16],
+        )
+    else:
+        logger.error(
+            "did NOT reproduce: violation %r vs recorded %r, log sha %s "
+            "vs recorded %s",
+            rep["violation"], rep["recorded_violation"],
+            rep["decision_log_sha256"][:16], rep["recorded_sha256"][:16],
+        )
+    _emit(args, {"engine": "repro", "ok": rep["match"], **rep})
+    return 0 if rep["match"] else 1
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "repro":
+        # subcommand form: the positional grammar below is the
+        # reference CLI's (srvcnt cltcnt idcnt); repro takes a path
+        return run_repro(argv[1:])
     args = build_parser().parse_args(argv)
     _select_backend(args.backend, args.mesh)
     if args.engine == "sim":

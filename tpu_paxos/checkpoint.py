@@ -27,6 +27,14 @@ import numpy as np
 
 _META = "tpu_paxos_meta"
 
+# Checkpoint format version, stamped into every checkpoint's metadata.
+# Bump when the serialized layout changes meaning (leaf set, dtypes,
+# field semantics) so a stale-format checkpoint is distinguishable
+# from a wrong-geometry one: "v2" = the post-qsums/commit_wait
+# SimState era (acks int8).  Checkpoints written before versioning
+# have no format string at all and restore() names that explicitly.
+FORMAT = "tpu-paxos-ckpt-v2"
+
 
 def save(path: str, state, meta: dict | None = None) -> None:
     """Write a state pytree (plus optional JSON-able metadata) to one
@@ -34,7 +42,7 @@ def save(path: str, state, meta: dict | None = None) -> None:
     leaves = jax.tree.leaves(state)
     payload = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     payload[_META] = np.frombuffer(
-        json.dumps(meta or {}).encode(), dtype=np.uint8
+        json.dumps({"format": FORMAT, **(meta or {})}).encode(), dtype=np.uint8
     )
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -50,11 +58,29 @@ def restore(path: str, like):
     structure = jax.tree.structure(like)
     ref_leaves = jax.tree.leaves(like)
     with np.load(path) as z:
+        meta = json.loads(bytes(z[_META]).decode()) if _META in z.files else {}
+        fmt = meta.get("format")
+        # A format mismatch is named FIRST: a checkpoint from another
+        # format era usually also trips the structural checks below,
+        # and "wrong config" would misdiagnose what is really a stale
+        # file.  (Same-format structural mismatches still mean wrong
+        # geometry/engine and keep their own error.)
+        fmt_note = (
+            ""
+            if fmt == FORMAT
+            else (
+                f" (checkpoint format {fmt!r} != current {FORMAT!r}"
+                if fmt
+                else f" (unversioned pre-{FORMAT!r} checkpoint"
+            )
+            + " — the file predates or postdates this build's state "
+            "layout)"
+        )
         n = len([k for k in z.files if k.startswith("leaf_")])
         if n != len(ref_leaves):
             raise ValueError(
                 f"checkpoint has {n} leaves, expected {len(ref_leaves)} — "
-                "wrong config or engine for this checkpoint"
+                f"wrong config or engine for this checkpoint{fmt_note}"
             )
         leaves = []
         for i, ref in enumerate(ref_leaves):
@@ -63,8 +89,8 @@ def restore(path: str, like):
             if arr.shape != ref.shape or arr.dtype != ref.dtype:
                 raise ValueError(
                     f"checkpoint leaf {i} is {arr.dtype}{list(arr.shape)}, "
-                    f"expected {ref.dtype}{list(ref.shape)} — wrong config"
+                    f"expected {ref.dtype}{list(ref.shape)} — wrong "
+                    f"config{fmt_note}"
                 )
             leaves.append(arr)
-        meta = json.loads(bytes(z[_META]).decode()) if _META in z.files else {}
     return jax.tree.unflatten(structure, leaves), meta

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import dataclasses
 
+from tpu_paxos.core.faults import FaultSchedule
+
 
 @dataclasses.dataclass(frozen=True)
 class ProtocolConfig:
@@ -83,6 +85,10 @@ class FaultConfig:
     # round, per 1_000_000 (ref member/indet.h:146-150 crashes with
     # failure_rate/1e6 on every log call).
     crash_rate: int = 0  # per 1_000_000
+    # Correlated-fault layer on top of the i.i.d. knobs above: a
+    # deterministic schedule of partition / one-way-cut / pause /
+    # burst-loss episodes (core/faults.py).  None = no episodes.
+    schedule: FaultSchedule | None = None
 
     def __post_init__(self) -> None:
         if not 0 <= self.drop_rate <= 10_000:
@@ -95,6 +101,10 @@ class FaultConfig:
             raise ValueError("min_delay must be >= 0")
         if not 0 <= self.crash_rate <= 1_000_000:
             raise ValueError("crash_rate must be in [0, 1000000]")
+        if self.schedule is not None and not isinstance(
+            self.schedule, FaultSchedule
+        ):
+            raise TypeError("schedule must be a FaultSchedule or None")
 
     @property
     def is_reliable(self) -> bool:
@@ -103,6 +113,7 @@ class FaultConfig:
             and self.min_delay == 0
             and self.max_delay == 0
             and self.crash_rate == 0
+            and (self.schedule is None or not self.schedule.episodes)
         )
 
 
@@ -145,3 +156,13 @@ class SimConfig:
     def quorum(self) -> int:
         # Majority quorum, ref multi/paxos.cpp:1047: n/2 + 1.
         return self.n_nodes // 2 + 1
+
+    @property
+    def round_budget(self) -> int:
+        """Liveness-watchdog round cap.  With a fault schedule, the
+        full ``max_rounds`` budget starts only at the last heal —
+        convergence is owed AFTER the final episode ends, however long
+        the schedule itself runs (the heal-then-converge contract,
+        core/faults.py)."""
+        s = self.faults.schedule
+        return self.max_rounds + (s.horizon if s is not None else 0)

@@ -1,3 +1,23 @@
-"""Protocol core: ballots, values, acceptor/proposer/learner round functions."""
+"""Protocol core: ballots, values, acceptor/proposer/learner round functions.
 
-from tpu_paxos.core import ballot, values  # noqa: F401
+Submodules are lazily re-exported (PEP 562), mirroring the top-level
+package: ``config.py`` imports ``core.faults`` (pure numpy) at package
+import, and that must NOT drag in ``ballot``/``values`` — they build
+jax device constants at import, which would initialize the backend
+before the CLI can select ``--backend``/``--mesh`` provisioning (and
+on a TPU-plugin container without ``JAX_PLATFORMS`` set, backend init
+blocks for minutes on instance-metadata fetches).
+"""
+
+_SUBMODULES = (
+    "apply", "ballot", "fast", "fastwin", "faults", "net", "sim",
+    "simkern", "values",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"tpu_paxos.core.{name}")
+    raise AttributeError(f"module 'tpu_paxos.core' has no attribute {name!r}")

@@ -1,0 +1,260 @@
+"""Correlated fault schedules: deterministic, round-interval episodes.
+
+The i.i.d. layer (``config.FaultConfig``: per-message drop/dup/delay,
+per-node fail-stop crashes) reproduces the reference's ``THNetWork`` +
+``RandomFailure`` model — but real consensus deployments die to
+*correlated* faults the reference never injects: network partitions,
+asymmetric (one-way) links, GC-style node pauses, and burst-loss
+windows.  This module adds that layer as a ``FaultSchedule`` of
+*episodes*, each active over a half-open round interval ``[t0, t1)``:
+
+- ``partition(t0, t1, *groups)`` — symmetric partition: nodes in
+  different groups cannot exchange messages in either direction
+  (nodes listed in no group form one implicit extra group);
+- ``one_way(t0, t1, src, dst)`` — asymmetric link cut: messages from
+  ``src`` nodes to ``dst`` nodes are lost, the reverse direction
+  stays up;
+- ``pause(t0, t1, *nodes)`` — node pause (a long GC / VM migration):
+  ALL of the node's I/O is suppressed while paused, but unlike a
+  crash its state is preserved and it resumes at ``t1``;
+- ``burst(t0, t1, drop_rate)`` — loss burst: ``drop_rate``/1e4 is
+  ADDED to the i.i.d. drop rate inside the window (clamped to 1e4).
+
+Episodes compose: overlapping cuts AND their reachability, pauses OR,
+burst rates add.  ``compile_schedule`` lowers a schedule into dense
+per-round tables — ``reach [H+1, N, N]``, ``paused [H+1, N]``,
+``extra_drop [H+1]`` with row ``H`` (the horizon = last episode end)
+fully healed — which the engines index with ``min(t, H)``; one gather
+per round, fully jit/shard_map-compatible, composing with the
+THNetWork-style sampling in ``core/net.py`` at *send* time (a message
+sent while its edge is cut is lost at the sender's NIC; copies
+already in flight still deliver — a schedule the i.i.d. drop fault
+already contains).
+
+Liveness contract (enforced by the engines): paused nodes are excused
+only *while* paused, and quiescence is never declared before the last
+heal — convergence is owed within ``max_rounds`` rounds past the
+final episode end (``SimConfig.round_budget``).
+
+Schedules are plain data (tuples of ints) so they serialize to JSON —
+the unit of the stress harness's shrink-and-repro artifacts
+(``harness/shrink.py``) — and hash/compare structurally, so they can
+be baked statically into an engine closure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+
+KINDS = ("partition", "one_way", "pause", "burst")
+
+
+@dataclasses.dataclass(frozen=True)
+class Episode:
+    """One correlated-fault episode, active over rounds [t0, t1)."""
+
+    kind: str
+    t0: int
+    t1: int
+    groups: tuple[tuple[int, ...], ...] = ()  # partition
+    src: tuple[int, ...] = ()  # one_way
+    dst: tuple[int, ...] = ()  # one_way
+    nodes: tuple[int, ...] = ()  # pause
+    drop_rate: int = 0  # burst, per 1e4, added to FaultConfig.drop_rate
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown episode kind {self.kind!r}")
+        if not 0 <= self.t0 < self.t1:
+            raise ValueError(
+                f"episode interval [{self.t0}, {self.t1}) must be "
+                "non-empty and start at round >= 0"
+            )
+        # canonicalize container args so episodes hash/compare stably
+        object.__setattr__(
+            self, "groups", tuple(tuple(int(x) for x in g) for g in self.groups)
+        )
+        for f in ("src", "dst", "nodes"):
+            object.__setattr__(
+                self, f, tuple(sorted(int(x) for x in getattr(self, f)))
+            )
+        if self.kind == "partition":
+            flat = [x for g in self.groups for x in g]
+            if not self.groups or not all(self.groups):
+                raise ValueError("partition needs non-empty groups")
+            if len(flat) != len(set(flat)):
+                raise ValueError("partition groups must be disjoint")
+        if self.kind == "one_way" and (not self.src or not self.dst):
+            raise ValueError("one_way needs non-empty src and dst")
+        if self.kind == "pause" and not self.nodes:
+            raise ValueError("pause needs at least one node")
+        if self.kind == "burst" and not 0 < self.drop_rate <= 10_000:
+            raise ValueError("burst drop_rate must be in (0, 10000]")
+
+    def shifted(self, t0: int, t1: int) -> "Episode":
+        """Same episode over a different interval (the shrinker's
+        interval-narrowing move)."""
+        return dataclasses.replace(self, t0=t0, t1=t1)
+
+    def _max_node(self) -> int:
+        return max(
+            [x for g in self.groups for x in g]
+            + list(self.src) + list(self.dst) + list(self.nodes)
+            + [0]
+        )
+
+
+def partition(t0: int, t1: int, *groups) -> Episode:
+    """Symmetric partition: nodes in different groups are mutually
+    unreachable during [t0, t1); unlisted nodes form one implicit
+    extra group."""
+    return Episode("partition", t0, t1, groups=tuple(tuple(g) for g in groups))
+
+
+def one_way(t0: int, t1: int, src, dst) -> Episode:
+    """One-way link cut: src -> dst messages are lost during [t0, t1)."""
+    return Episode("one_way", t0, t1, src=tuple(src), dst=tuple(dst))
+
+
+def pause(t0: int, t1: int, *nodes) -> Episode:
+    """Pause nodes during [t0, t1): state preserved, all I/O suppressed."""
+    return Episode("pause", t0, t1, nodes=tuple(nodes))
+
+
+def burst(t0: int, t1: int, drop_rate: int) -> Episode:
+    """Loss burst: add drop_rate/1e4 to the i.i.d. drop rate in [t0, t1)."""
+    return Episode("burst", t0, t1, drop_rate=drop_rate)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSchedule:
+    """An immutable, hashable sequence of episodes (see module doc)."""
+
+    episodes: tuple[Episode, ...] = ()
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "episodes", tuple(self.episodes))
+        for e in self.episodes:
+            if not isinstance(e, Episode):
+                raise TypeError(f"episodes must be Episode, got {type(e)}")
+
+    @property
+    def horizon(self) -> int:
+        """First round at which every episode has ended (0 if empty)."""
+        return max((e.t1 for e in self.episodes), default=0)
+
+    def without(self, i: int) -> "FaultSchedule":
+        """Schedule minus episode ``i`` (the shrinker's drop move)."""
+        eps = self.episodes
+        return FaultSchedule(eps[:i] + eps[i + 1:])
+
+    def replaced(self, i: int, ep: Episode) -> "FaultSchedule":
+        eps = list(self.episodes)
+        eps[i] = ep
+        return FaultSchedule(tuple(eps))
+
+    # -- JSON plumbing for repro artifacts / injection logs --
+    def to_dict(self) -> dict:
+        return {
+            "episodes": [
+                {
+                    k: (list(map(list, v)) if k == "groups" else
+                        list(v) if isinstance(v, tuple) else v)
+                    for k, v in dataclasses.asdict(e).items()
+                }
+                for e in self.episodes
+            ]
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSchedule":
+        eps = []
+        for e in d.get("episodes", []):
+            eps.append(
+                Episode(
+                    kind=e["kind"],
+                    t0=e["t0"],
+                    t1=e["t1"],
+                    groups=tuple(tuple(g) for g in e.get("groups", ())),
+                    src=tuple(e.get("src", ())),
+                    dst=tuple(e.get("dst", ())),
+                    nodes=tuple(e.get("nodes", ())),
+                    drop_rate=e.get("drop_rate", 0),
+                )
+            )
+        return cls(tuple(eps))
+
+
+class CompiledSchedule(NamedTuple):
+    """Dense per-round tables, horizon+1 rows; row ``horizon`` is the
+    healed steady state (engines index with ``min(t, horizon)``).
+    The ``has_*`` flags are compile-time: an engine elides the table
+    gather (and, for ``reach``, the per-edge send masking) entirely
+    when a dimension is absent from the schedule."""
+
+    reach: np.ndarray  # [H+1, N, N] bool, True = src row can reach dst col
+    paused: np.ndarray  # [H+1, N] bool
+    extra_drop: np.ndarray  # [H+1] int32, additional per-1e4 drop rate
+    horizon: int
+    has_reach: bool
+    has_pause: bool
+    has_burst: bool
+
+
+def compile_schedule(
+    sched: FaultSchedule | None, n_nodes: int
+) -> CompiledSchedule | None:
+    """Lower a schedule to per-round tables for ``n_nodes`` nodes.
+    Returns None for an absent/empty schedule (engines then compile
+    with zero overhead)."""
+    if sched is None or not sched.episodes:
+        return None
+    for e in sched.episodes:
+        if e._max_node() >= n_nodes:
+            raise ValueError(
+                f"episode {e.kind}[{e.t0},{e.t1}) names node "
+                f"{e._max_node()} but the cluster has {n_nodes} nodes"
+            )
+        if e.kind == "partition":
+            # a single group needs unlisted nodes to form the implicit
+            # complement, or the 'partition' cuts nothing
+            listed = sum(len(g) for g in e.groups)
+            if len(e.groups) < 2 and listed >= n_nodes:
+                raise ValueError(
+                    f"partition[{e.t0},{e.t1}) lists every node in one "
+                    "group — nothing is cut; name >= 2 groups or leave "
+                    "nodes unlisted to form the implicit complement"
+                )
+    h = sched.horizon
+    reach = np.ones((h + 1, n_nodes, n_nodes), bool)
+    paused = np.zeros((h + 1, n_nodes), bool)
+    extra = np.zeros((h + 1,), np.int64)
+    for e in sched.episodes:
+        rows = slice(e.t0, e.t1)  # t1 <= h, so row h stays healed
+        if e.kind == "partition":
+            group_of = np.full((n_nodes,), len(e.groups), np.int32)
+            for gi, g in enumerate(e.groups):
+                group_of[list(g)] = gi
+            same = group_of[:, None] == group_of[None, :]
+            reach[rows] &= same[None]
+        elif e.kind == "one_way":
+            cut = np.zeros((n_nodes, n_nodes), bool)
+            cut[np.ix_(list(e.src), list(e.dst))] = True
+            reach[rows] &= ~cut[None]
+        elif e.kind == "pause":
+            paused[rows, list(e.nodes)] = True
+        elif e.kind == "burst":
+            extra[rows] += e.drop_rate
+    np.einsum("tnn->tn", reach)[:] = True  # a node always reaches itself
+    return CompiledSchedule(
+        reach=reach,
+        paused=paused,
+        extra_drop=np.minimum(extra, 10_000).astype(np.int32),
+        horizon=h,
+        has_reach=any(e.kind in ("partition", "one_way") for e in sched.episodes),
+        has_pause=any(e.kind == "pause" for e in sched.episodes),
+        has_burst=any(e.kind == "burst" for e in sched.episodes),
+    )

@@ -114,7 +114,12 @@ def clear_slot(buffers: NetBuffers, slot) -> NetBuffers:
     return jax.tree.map(_clr, buffers)
 
 
-def copy_plan(key: jax.Array, edge_shape: tuple[int, ...], fc: FaultConfig):
+def copy_plan(
+    key: jax.Array,
+    edge_shape: tuple[int, ...],
+    fc: FaultConfig,
+    extra_drop=None,
+):
     """Sample the THNetWork fault plan for one broadcast/send.
 
     Returns (alive [MAX_COPIES, *edge_shape] bool,
@@ -123,13 +128,21 @@ def copy_plan(key: jax.Array, edge_shape: tuple[int, ...], fc: FaultConfig):
     rounds.  Copy 0 is the original (droppable); copies 1..3 exist via
     the recursive duplication chain and are never dropped
     (ref multi/main.cpp:116-123).
+
+    ``extra_drop`` (traced int32 scalar, or None) is the fault
+    schedule's burst-loss addition for this round (core/faults.py):
+    it adds to ``fc.drop_rate``, clamped to 10_000.  Engines pass it
+    only when the schedule contains burst episodes, so burst-free
+    configs keep the static drop-sampling elision.
     """
     k_drop, k_dup, k_delay = jax.random.split(key, 3)
-    drop = (
-        jax.random.randint(k_drop, edge_shape, 0, 10_000) < fc.drop_rate
-        if fc.drop_rate
-        else jnp.zeros(edge_shape, jnp.bool_)
-    )
+    if extra_drop is not None:
+        rate = jnp.minimum(jnp.int32(fc.drop_rate) + extra_drop, 10_000)
+        drop = jax.random.randint(k_drop, edge_shape, 0, 10_000) < rate
+    elif fc.drop_rate:
+        drop = jax.random.randint(k_drop, edge_shape, 0, 10_000) < fc.drop_rate
+    else:
+        drop = jnp.zeros(edge_shape, jnp.bool_)
     if fc.dup_rate:
         coins = (
             jax.random.randint(k_dup, (MAX_COPIES - 1, *edge_shape), 0, 10_000)

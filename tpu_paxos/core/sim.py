@@ -66,6 +66,22 @@ are fail-stop node silences capped at a minority of nodes (the
 reference's member/ crash aborts the whole run and validates the
 prefix; here the run continues on the surviving majority and the same
 prefix validation applies).
+
+Correlated faults (core/faults.py) compose on top of the i.i.d.
+layer: a ``FaultSchedule`` of partition / one-way-cut / pause /
+burst-loss episodes compiles to per-round tables the round function
+indexes with ``min(t, horizon)``.  Edge reachability masks AND into
+every send mask (a message on a cut edge is lost at the sender's
+NIC); pauses subtract from the I/O-alive mask exactly like crashes —
+no sends, no receives, no timer actions — but the node's state is
+preserved and it resumes at the episode end; burst windows add to the
+drop rate sampled in ``net.copy_plan``.  The liveness contract:
+quiescence is never declared before the last heal, only *crashed*
+proposers are excused from frontier extension (a paused proposer's
+values are owed after it resumes), the commit-until-all-acked ladder
+survives its proposer via the stall-triggered commit takeover, and
+the watchdog budget is ``max_rounds`` past the final episode end
+(``SimConfig.round_budget``).
 """
 
 from __future__ import annotations
@@ -79,6 +95,7 @@ import numpy as np
 
 from tpu_paxos.config import SimConfig
 from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import faults as fltm
 from tpu_paxos.core import net as netm
 from tpu_paxos.core import values as val
 from tpu_paxos.utils import prng
@@ -397,6 +414,23 @@ def build_engine(
         raise ValueError(f"n_instances {i_cap} not divisible by {n_shards}")
     i_loc = i_cap // n_shards  # instances per shard ([I]-axis array size)
     max_crash = (a - 1) // 2
+    # Correlated-fault schedule, lowered to dense per-round tables and
+    # baked in as compile-time constants (replicated under shard_map —
+    # every shard indexes identical tables with the replicated round
+    # counter, so schedule faults never diverge across shards).
+    comp = fltm.compile_schedule(fc.schedule, a)
+    horizon = comp.horizon if comp is not None else 0
+    reach_tab = (
+        jnp.asarray(comp.reach) if comp is not None and comp.has_reach else None
+    )
+    pause_tab = (
+        jnp.asarray(comp.paused) if comp is not None and comp.has_pause else None
+    )
+    drop_tab = (
+        jnp.asarray(comp.extra_drop)
+        if comp is not None and comp.has_burst
+        else None
+    )
     from tpu_paxos.core import simkern as _sk
 
     if use_pallas is None:
@@ -479,8 +513,38 @@ def build_engine(
         ar = jax.tree.map(lambda b: b[slot], st.net)
         net = netm.clear_slot(st.net, slot)
 
+        # Fault-schedule tables for this round (min(t, horizon): row
+        # `horizon` is the healed steady state, so post-schedule
+        # rounds read all-clear masks at no branch cost).
+        tt = jnp.minimum(t, jnp.int32(horizon)) if comp is not None else None
+        paused_t = pause_tab[tt] if pause_tab is not None else None  # [A]
+        reach_t = reach_tab[tt] if reach_tab is not None else None  # [N, N]
+        xdrop_t = drop_tab[tt] if drop_tab is not None else None  # int32
+
+        # I/O-alive mask: crashed OR currently paused nodes neither
+        # send, receive, nor act on timers this round.  Excusals
+        # (quiescence, frontier extension, commit-ack waivers) stay on
+        # `st.crashed` alone — a paused node's obligations are only
+        # deferred, never waived.
         alive_a = ~st.crashed  # [A]
+        if paused_t is not None:
+            alive_a = alive_a & ~paused_t
         prop_alive = alive_a[pn]  # [P]
+
+        # Per-edge reachability cuts ANDed into every send mask below
+        # (send-time semantics: copies already in the calendars still
+        # deliver — a schedule the i.i.d. drop fault already contains).
+        reach_pa = reach_t[pn] if reach_t is not None else None  # [P, A]
+        reach_ap = reach_t[:, pn] if reach_t is not None else None  # [A, P]
+
+        def _cut_pa(m):  # [P, A] proposer->node send mask through cuts
+            return m if reach_pa is None else m & reach_pa
+
+        def _cut_ap(m):  # [A, P] node->proposer send mask through cuts
+            return m if reach_ap is None else m & reach_ap
+
+        def _plan(key, edge_shape):
+            return netm.copy_plan(key, edge_shape, fc, extra_drop=xdrop_t)
 
         keys = jax.random.split(prng.stream(root, prng.STREAM_NET_DROP, t), 8)
 
@@ -602,8 +666,15 @@ def build_engine(
 
         # ---------------- proposer side ----------------
         pr = st.prop
+        # A->P arrivals are masked on BOTH ends: the sending acceptor
+        # must be I/O-alive at delivery (reply payloads materialize
+        # from its state) and so must the receiving proposer — a
+        # paused proposer's inbound I/O is suppressed, not buffered
+        # (for a crashed receiver this is behavior-neutral: every
+        # action mask already excludes it forever).
+        rx_p = alive_a[:, None] & prop_alive[None, :]  # [A, P]
         # REJECT arrivals only update max-ballot-seen (ref OnReject).
-        rejs = jnp.where(alive_a[:, None], ar.rej, bal.NONE)  # [A, P]
+        rejs = jnp.where(rx_p, ar.rej, bal.NONE)  # [A, P]
         pmax_seen = jnp.maximum(pr.pmax_seen, jnp.max(rejs, axis=0))
 
         # PREPARE_REPLY arrivals: promises + adoption merge.  The
@@ -619,7 +690,7 @@ def build_engine(
         # at the accept/commit conds, letting XLA alias their
         # pass-through branches instead of copying [A, I] carries
         # every round.
-        pecho = jnp.where(alive_a[:, None], ar.prep_echo, bal.NONE)  # [A, P]
+        pecho = jnp.where(rx_p, ar.prep_echo, bal.NONE)  # [A, P]
         match = (pecho == pr.ballot[None, :]) & (pr.mode[None, :] == PREPARING)
         promises2 = pr.promises | match.T  # [P, A]
         # Prepare replies only arrive while some proposer is in its
@@ -700,11 +771,13 @@ def build_engine(
             hi_loc = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)
             # crashed proposers are excused (their queues are dead,
             # exactly as q_empty excuses them) or the shard could
-            # never close
+            # never close.  PAUSED proposers are NOT excused — their
+            # queued values are owed after the heal, so the frontier
+            # must not no-op past space they still need.
             drained = (
                 (pr.head >= pr.tail)
                 & jnp.all(pr.own_assign == val.NONE, axis=1)
-            ) | ~prop_alive  # [P] this shard's queue fully placed
+            ) | st.crashed[pn]  # [P] this shard's queue fully placed
             hi = jnp.where(jnp.all(drained), gmax(hi_loc), hi_loc)
             below = idx[None] <= hi[:, None]
             noop_fill = below & ~covered0
@@ -877,7 +950,7 @@ def build_engine(
         # batch's value at this ballot (so it certifiably stored
         # (ballot, v)), or committed exactly this value.  Acks lost to
         # higher-ballot overwrites in between are reply drops — legal.
-        aecho = jnp.where(alive_a[:, None], ar.acc_echo, bal.NONE)  # [A, P]
+        aecho = jnp.where(rx_p, ar.acc_echo, bal.NONE)  # [A, P]
         amatch = (aecho == pr.ballot[None, :]) & (mode[None, :] == PREPARED)
         # Ack accumulation and chosen-detection only on rounds a reply
         # actually arrives: acks (hence n_ack, hence a new decision)
@@ -943,7 +1016,7 @@ def build_engine(
         # derives from learned-state match (learned is write-once, so
         # this is exact — the replier has learned the value iff its
         # learned cell equals the committed vid).
-        crep = ar.com_rep & alive_a[:, None]  # [A, P]
+        crep = ar.com_rep & rx_p  # [A, P]
         any_crep = rany(crep)
 
         def _accum_commit_acks(commit_acked):
@@ -972,11 +1045,53 @@ def build_engine(
                 lambda ca: (ca, pr.commit_wait),
                 pr.commit_acked,
             )
+        # Commit TAKEOVER: the commit-until-all-acked obligation
+        # (ref :1625-1641) must not die with its proposer.  If the
+        # committer crashes (or pauses through its ladder) after a
+        # quorum chose a value but before every live node learned it,
+        # no hole remains — every survivor sees the instance as
+        # committed, builds an EMPTY batch, and the undelivered
+        # learners starve (the exact wedge: a node paused through the
+        # commit window whose committer then crashed).  So a proposer
+        # whose idle-liveness patience runs out (same stall threshold
+        # that triggers its re-prepare below) adopts commit_vid :=
+        # its own learned values wherever it holds no commitment yet
+        # — re-committing a learned (hence chosen, write-once) value
+        # is always safe — and the ordinary resend ladder delivers to
+        # the lagging nodes.  Fires only on stall-threshold rounds, so
+        # the [P, I] pass is cond-gated off the common path.  (The
+        # membership engine needs no analog: its learners anti-entropy
+        # PULL their gaps each round.)
+        take_commit = (
+            (pr.mode == PREPARED)
+            & (pr.stall >= IDLE_RESTART_ROUNDS)
+            & prop_alive
+        )
+        any_take = rany(take_commit)
+
+        def _takeover(commit_vid, commit_wait):
+            taken = (
+                take_commit[:, None]
+                & (learned[pn] != val.NONE)
+                & (commit_vid == val.NONE)
+            )
+            took = gany(jnp.any(taken, axis=1))  # [P]
+            return (
+                jnp.where(taken, learned[pn], commit_vid),
+                commit_wait | took,
+            )
+
+        commit_vid, commit_wait = jax.lax.cond(
+            any_take,
+            _takeover,
+            lambda cv, cw: (cv, cw),
+            commit_vid, commit_wait,
+        )
         # A fresh decision is by construction not fully acked yet.
         any_newly = gany(jnp.any(newly, axis=1))  # [P]
         commit_wait = commit_wait | any_newly
         resend_c = (t >= pr.commit_deadline) & commit_wait  # [P]
-        send_commit = (any_newly | resend_c) & prop_alive
+        send_commit = (any_newly | resend_c | (take_commit & commit_wait)) & prop_alive
         commit_deadline = jnp.where(
             send_commit, t + 1 + pc.commit_retry_timeout, pr.commit_deadline
         )
@@ -1244,61 +1359,68 @@ def build_engine(
         )
 
         # ---------------- network writes ----------------
+        # Every send mask passes through the schedule's reachability
+        # cut (_cut_pa/_cut_ap); burst windows ride copy_plan's
+        # extra_drop (_plan).  Message counters below stay pre-fault.
         edge_pa = (p, a)
         # prepare requests
-        al, dl = netm.copy_plan(keys[0], edge_pa, fc)
+        al, dl = _plan(keys[0], edge_pa)
         net = net._replace(
             prep_req=netm.write_ballot(
-                net.prep_req, t, al, dl, ballot[:, None], send_prep[:, None]
+                net.prep_req, t, al, dl, ballot[:, None],
+                _cut_pa(send_prep[:, None] & jnp.ones((p, a), jnp.bool_)),
             )
         )
         # prepare replies (granted only; snapshot read at delivery)
-        al, dl = netm.copy_plan(keys[1], (a, p), fc)
+        al, dl = _plan(keys[1], (a, p))
         send_rep = grant.T  # [A, P]
         echo_val = preq.T  # [A, P] the granted ballot
         net = net._replace(
             prep_echo=netm.write_ballot(
-                net.prep_echo, t, al, dl, echo_val, send_rep
+                net.prep_echo, t, al, dl, echo_val, _cut_ap(send_rep)
             )
         )
         # rejects (both phases share one message, ref MSG_REJECT)
-        al, dl = netm.copy_plan(keys[2], (a, p), fc)
+        al, dl = _plan(keys[2], (a, p))
         send_rej = (rej_prep | rej_acc).T
         net = net._replace(
             rej=netm.write_ballot(
                 net.rej, t, al, dl,
-                jnp.broadcast_to(max_seen[:, None], (a, p)), send_rej,
+                jnp.broadcast_to(max_seen[:, None], (a, p)),
+                _cut_ap(send_rej),
             )
         )
         # accepts: per-edge ballot (batch content read at delivery)
-        al, dl = netm.copy_plan(keys[3], edge_pa, fc)
+        al, dl = _plan(keys[3], edge_pa)
         net = net._replace(
             acc_req=netm.write_ballot(
-                net.acc_req, t, al, dl, ballot[:, None], send_accept[:, None]
+                net.acc_req, t, al, dl, ballot[:, None],
+                _cut_pa(send_accept[:, None] & jnp.ones((p, a), jnp.bool_)),
             )
         )
         # accept replies (ack rows derived at delivery)
-        al, dl = netm.copy_plan(keys[4], (a, p), fc)
+        al, dl = _plan(keys[4], (a, p))
         send_arep = elig.T  # [A, P] reply whenever ballot >= promised
         aecho_val = jnp.broadcast_to(abal[None, :], (a, p))
         net = net._replace(
             acc_echo=netm.write_ballot(
-                net.acc_echo, t, al, dl, aecho_val, send_arep
+                net.acc_echo, t, al, dl, aecho_val, _cut_ap(send_arep)
             )
         )
         # commits: per-edge presence (content read at delivery from
         # the sender's write-once commit_vid)
-        al, dl = netm.copy_plan(keys[5], edge_pa, fc)
+        al, dl = _plan(keys[5], edge_pa)
         net = net._replace(
             com_pres=netm.write_flag(
-                net.com_pres, t, al, dl, send_commit[:, None]
+                net.com_pres, t, al, dl,
+                _cut_pa(send_commit[:, None] & jnp.ones((p, a), jnp.bool_)),
             )
         )
         # commit replies: presence; ack-by-learned-match at delivery
-        al, dl = netm.copy_plan(keys[6], (a, p), fc)
+        al, dl = _plan(keys[6], (a, p))
         send_crep = cpres.T  # [A, P]
         net = net._replace(
-            com_rep=netm.write_flag(net.com_rep, t, al, dl, send_crep)
+            com_rep=netm.write_flag(net.com_rep, t, al, dl, _cut_ap(send_crep))
         )
 
         # message counters (logical sends, pre-fault)
@@ -1385,6 +1507,13 @@ def build_engine(
         contiguous = n_chosen == hmax + 1
         learned_ok = jnp.all((n_learned == hmax + 1) | crashed)
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
+        if horizon:
+            # Heal-then-converge contract: quiescence is never declared
+            # before the last episode ends — a paused node's catch-up
+            # (and a partitioned minority's repair) is owed, not
+            # waived, and the watchdog budget (round_budget) grants
+            # max_rounds past this point to deliver it.
+            done = done & (t >= jnp.int32(horizon))
 
         # Stall accounting for the idle-liveness restart: a proposer is
         # idle when PREPARED with nothing undecided in flight, an empty
@@ -1554,7 +1683,7 @@ def run_state(
     @jax.jit
     def _go(root, state):
         def cond(st):
-            return (~st.done) & (st.t < cfg.max_rounds)
+            return (~st.done) & (st.t < cfg.round_budget)
 
         def body(st):
             return round_fn(root, st)

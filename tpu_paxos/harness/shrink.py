@@ -1,0 +1,407 @@
+"""Failure triage: greedy shrinking of a failing stress case into a
+minimal, one-command repro artifact.
+
+When a stress seed violates an invariant, the interesting question is
+never "which seed" — it is "which *part of the fault schedule* makes
+the violation happen".  This module answers it the property-testing
+way: re-run the deterministic case under progressively smaller inputs
+and keep every reduction that still fails —
+
+1. drop whole episodes from the ``FaultSchedule`` (greedy, to a fixed
+   point);
+2. narrow each surviving episode's ``[t0, t1)`` interval by bisection
+   (cut the tail half, then the head half, while the case still
+   fails);
+3. zero the i.i.d. fault knobs (drop/dup/delay/crash) one at a time;
+4. minimize the seed (try 0 and successive bisections toward 0).
+
+The result is written as a JSON *repro artifact* — fully
+self-contained: config, workload, gates, in-order chains, extra
+checks, the violation text, and the decision-log sha256 — which
+``python -m tpu_paxos repro <artifact>`` re-executes byte-identically
+(the engine is a pure function of the artifact's fields; the spirit
+of ref member/diff.sh's record-vs-replay byte compare).
+
+Everything here drives the *general* engine (core/sim.run); the
+membership engine has its own record/replay artifact (the injection
+log, membership/engine.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from tpu_paxos.config import FaultConfig, ProtocolConfig, SimConfig
+from tpu_paxos.core import faults as fltm
+from tpu_paxos.core import sim as simm
+from tpu_paxos.harness import validate
+from tpu_paxos.replay.decision_log import decision_log
+
+ARTIFACT_FORMAT = "tpu-paxos-repro-1"
+
+# Cap on shrink re-runs: each candidate evaluation is a full engine
+# run (tiny configs, but a compile each when the schedule changes
+# shape).  The greedy passes converge long before this in practice.
+MAX_EVALS = 200
+
+
+@dataclasses.dataclass
+class ReproCase:
+    """A fully-specified deterministic run plus its judgment criteria."""
+
+    cfg: SimConfig
+    workload: list[np.ndarray]
+    gates: list[np.ndarray] | None
+    chains: list[np.ndarray]  # in-order client chains (may be empty)
+    extra_checks: dict = dataclasses.field(default_factory=dict)
+
+    def with_faults(self, faults: FaultConfig) -> "ReproCase":
+        return dataclasses.replace(
+            self, cfg=dataclasses.replace(self.cfg, faults=faults)
+        )
+
+    def with_schedule(self, sched: fltm.FaultSchedule | None) -> "ReproCase":
+        if sched is not None and not sched.episodes:
+            sched = None
+        return self.with_faults(
+            dataclasses.replace(self.cfg.faults, schedule=sched)
+        )
+
+
+def validate_run(r, cfg: SimConfig, workload, chains) -> None:
+    """Crash-aware invariant suite shared by the stress sweep and the
+    shrinker: safety (agreement, executed-identical, at-most-once,
+    only-workload values) holds unconditionally; liveness is owed only
+    to values whose proposer survived — a crashed proposer's undrained
+    queue is legitimately lost (cf.
+    tests/test_sim.py::test_crash_minority_safety_and_liveness).
+    Paused/partitioned proposers get no such waiver: after the last
+    heal their values are owed like anyone else's."""
+    crashed_props = [
+        i for i, node in enumerate(cfg.proposers) if r.crashed[node]
+    ]
+    full = np.unique(np.concatenate(workload))
+    if not crashed_props:
+        seqs = validate.check_all(r.learned, full)
+    else:
+        validate.check_agreement(r.learned)
+        seqs = validate.check_executed_identical(r.learned)
+        validate.check_exactly_once(r.learned, None)  # at most once
+        chosen = r.chosen_vid[r.chosen_vid >= 0]
+        extra = np.setdiff1d(chosen, full)
+        if extra.size:
+            raise validate.InvariantViolation(
+                f"non-workload values chosen: {extra[:8].tolist()}"
+            )
+        live = [
+            w for i, w in enumerate(workload) if i not in crashed_props
+        ]
+        if live:  # with every proposer crashed, no liveness is owed
+            missing = np.setdiff1d(np.unique(np.concatenate(live)), chosen)
+            if missing.size:
+                raise validate.InvariantViolation(
+                    f"surviving proposers' values never chosen: "
+                    f"{missing[:8].tolist()}"
+                )
+    live_chains = [
+        ch for i, ch in enumerate(chains) if i not in crashed_props and len(ch)
+    ]
+    if live_chains:
+        validate.check_in_order_clients(max(seqs, key=len), live_chains)
+
+
+def _extra_checks(case: ReproCase, r) -> None:
+    """Artifact-recorded auxiliary invariants.  ``decision_round_max``
+    is the test hook the acceptance path uses: assert every decision
+    lands by round R (a deliberately-tight R turns a slow-converging
+    schedule into a reproducible 'violation' without touching the real
+    invariants)."""
+    rmax = case.extra_checks.get("decision_round_max")
+    if rmax is not None:
+        rounds = r.chosen_round[r.chosen_vid != -1]
+        if rounds.size and int(rounds.max()) > int(rmax):
+            raise validate.InvariantViolation(
+                f"decision at round {int(rounds.max())} exceeds "
+                f"decision_round_max={int(rmax)}"
+            )
+
+
+def check_run(r, cfg: SimConfig, workload, chains) -> None:
+    """Quiescence + the crash-aware suite.  Quiescence is excused only
+    when EVERY proposer crashed — then no one is left to drive the log
+    closed and liveness is vacuously unowed (safety still checked)."""
+    all_props_crashed = all(r.crashed[node] for node in cfg.proposers)
+    if not r.done and not all_props_crashed:
+        raise validate.InvariantViolation(
+            f"no quiescence in {r.rounds} rounds"
+        )
+    validate_run(r, cfg, workload, chains)
+
+
+def run_case(case: ReproCase):
+    """Execute the case; returns (SimResult, violation-string-or-None)."""
+    r = simm.run(case.cfg, case.workload, case.gates)
+    try:
+        check_run(r, case.cfg, case.workload, case.chains)
+        _extra_checks(case, r)
+    except validate.InvariantViolation as e:
+        return r, str(e)
+    return r, None
+
+
+def decision_log_text(case: ReproCase, r) -> str:
+    """Canonical decision-log rendering for the byte-compare surface;
+    stride is derived from the workload so arbitrary vids decode
+    stably."""
+    stride = int(max(int(np.max(w)) for w in case.workload if len(w))) + 1
+    return decision_log(
+        r.chosen_vid, r.chosen_ballot,
+        stride=stride, n_instances=case.cfg.n_instances,
+    )
+
+
+def _sha256(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+class _Budget:
+    def __init__(self, n: int):
+        self.left = n
+
+    def spend(self) -> bool:
+        self.left -= 1
+        return self.left >= 0
+
+
+def shrink_case(
+    case: ReproCase, max_evals: int = MAX_EVALS, logger=None
+) -> tuple[ReproCase, str]:
+    """Greedily minimize a failing case (see module doc for the move
+    set).  Returns (shrunk case, its violation).  Raises ValueError if
+    the input case does not fail — there is nothing to triage."""
+    _, viol = run_case(case)
+    if viol is None:
+        raise ValueError("case does not fail; nothing to shrink")
+    budget = _Budget(max_evals)
+
+    def note(msg):
+        if logger is not None:
+            logger.info("shrink: %s", msg)
+
+    def try_case(cand: ReproCase):
+        if not budget.spend():
+            return None
+        _, v = run_case(cand)
+        return v
+
+    changed = True
+    while changed and budget.left > 0:
+        changed = False
+        # 1. drop episodes, greedily to a fixed point
+        sched = case.cfg.faults.schedule
+        i = 0
+        while sched is not None and i < len(sched.episodes):
+            v = try_case(case.with_schedule(sched.without(i)))
+            if v is not None:
+                ep = sched.episodes[i]
+                note(f"dropped {ep.kind}[{ep.t0},{ep.t1})")
+                case, viol = case.with_schedule(sched.without(i)), v
+                sched = case.cfg.faults.schedule
+                changed = True
+            else:
+                i += 1
+        # 2. narrow surviving intervals by bisection
+        sched = case.cfg.faults.schedule
+        if sched is not None:
+            for i in range(len(sched.episodes)):
+                while budget.left > 0:
+                    sched = case.cfg.faults.schedule
+                    ep = sched.episodes[i]
+                    w = ep.t1 - ep.t0
+                    if w <= 1:
+                        break
+                    narrowed = None
+                    for t0, t1 in (
+                        (ep.t0, ep.t0 + w // 2),  # cut the tail half
+                        (ep.t1 - w // 2, ep.t1),  # cut the head half
+                    ):
+                        cand = case.with_schedule(
+                            sched.replaced(i, ep.shifted(t0, t1))
+                        )
+                        v = try_case(cand)
+                        if v is not None:
+                            narrowed, viol = cand, v
+                            note(
+                                f"narrowed {ep.kind} to [{t0},{t1})"
+                            )
+                            break
+                    if narrowed is None:
+                        break
+                    case, changed = narrowed, True
+        # 3. zero the i.i.d. fault knobs one at a time
+        for repl in (
+            {"drop_rate": 0},
+            {"dup_rate": 0},
+            {"min_delay": 0, "max_delay": 0},
+            {"crash_rate": 0},
+        ):
+            fc = case.cfg.faults
+            if all(getattr(fc, k) == v for k, v in repl.items()):
+                continue
+            v = try_case(case.with_faults(dataclasses.replace(fc, **repl)))
+            if v is not None:
+                note(f"zeroed {'/'.join(repl)}")
+                case = case.with_faults(dataclasses.replace(fc, **repl))
+                viol, changed = v, True
+        # 4. seed minimization (bisect toward 0)
+        while case.cfg.seed > 0 and budget.left > 0:
+            for cand_seed in (0, case.cfg.seed // 2):
+                if cand_seed == case.cfg.seed:
+                    continue
+                cand = dataclasses.replace(
+                    case, cfg=dataclasses.replace(case.cfg, seed=cand_seed)
+                )
+                v = try_case(cand)
+                if v is not None:
+                    note(f"seed -> {cand_seed}")
+                    case, viol, changed = cand, v, True
+                    break
+            else:
+                break
+    return case, viol
+
+
+# ---------------- artifact (de)serialization ----------------
+
+def _cfg_to_dict(cfg: SimConfig) -> dict:
+    fc = cfg.faults
+    return {
+        "n_nodes": cfg.n_nodes,
+        "n_instances": cfg.n_instances,
+        "proposers": list(cfg.proposers),
+        "seed": cfg.seed,
+        "max_rounds": cfg.max_rounds,
+        "assign_window": cfg.assign_window,
+        "protocol": dataclasses.asdict(cfg.protocol),
+        "faults": {
+            "drop_rate": fc.drop_rate,
+            "dup_rate": fc.dup_rate,
+            "min_delay": fc.min_delay,
+            "max_delay": fc.max_delay,
+            "crash_rate": fc.crash_rate,
+            "schedule": (
+                fc.schedule.to_dict() if fc.schedule is not None else None
+            ),
+        },
+    }
+
+
+def _cfg_from_dict(d: dict) -> SimConfig:
+    f = dict(d["faults"])
+    sched = f.pop("schedule", None)
+    return SimConfig(
+        n_nodes=d["n_nodes"],
+        n_instances=d["n_instances"],
+        proposers=tuple(d["proposers"]),
+        seed=d["seed"],
+        max_rounds=d["max_rounds"],
+        assign_window=d["assign_window"],
+        protocol=ProtocolConfig(**d["protocol"]),
+        faults=FaultConfig(
+            **f,
+            schedule=(
+                fltm.FaultSchedule.from_dict(sched) if sched else None
+            ),
+        ),
+    )
+
+
+def save_artifact(path: str, case: ReproCase, violation: str) -> dict:
+    """Run the (already-shrunk) case once more to pin its decision-log
+    hash, then write the self-contained artifact."""
+    r, v = run_case(case)
+    if v != violation:
+        # the case must be deterministic — a drifting violation means
+        # the artifact would not reproduce and must not be written
+        raise RuntimeError(
+            f"violation drifted between runs: {violation!r} -> {v!r}"
+        )
+    art = {
+        "format": ARTIFACT_FORMAT,
+        "cfg": _cfg_to_dict(case.cfg),
+        "workload": [np.asarray(w).tolist() for w in case.workload],
+        "gates": (
+            None
+            if case.gates is None
+            else [np.asarray(g).tolist() for g in case.gates]
+        ),
+        "chains": [np.asarray(c).tolist() for c in case.chains],
+        "extra_checks": case.extra_checks,
+        "violation": violation,
+        "decision_log_sha256": _sha256(decision_log_text(case, r)),
+        "rounds": r.rounds,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(art, f, indent=1)
+    os.replace(tmp, path)
+    return art
+
+
+def load_artifact(path: str) -> tuple[ReproCase, dict]:
+    with open(path) as f:
+        art = json.load(f)
+    if art.get("format") != ARTIFACT_FORMAT:
+        raise ValueError(
+            f"unknown repro-artifact format {art.get('format')!r} "
+            f"(expected {ARTIFACT_FORMAT!r})"
+        )
+    case = ReproCase(
+        cfg=_cfg_from_dict(art["cfg"]),
+        workload=[np.asarray(w, np.int32) for w in art["workload"]],
+        gates=(
+            None
+            if art["gates"] is None
+            else [np.asarray(g, np.int32) for g in art["gates"]]
+        ),
+        chains=[np.asarray(c, np.int32) for c in art["chains"]],
+        extra_checks=art.get("extra_checks") or {},
+    )
+    return case, art
+
+
+def reproduce(path: str) -> dict:
+    """Re-execute an artifact; returns the comparison against its
+    recorded outcome.  ``match`` is True iff the identical violation
+    recurs AND the decision log byte-compares equal (via sha256)."""
+    case, art = load_artifact(path)
+    r, violation = run_case(case)
+    log_text = decision_log_text(case, r)
+    sha = _sha256(log_text)
+    return {
+        "artifact": path,
+        "violation": violation,
+        "recorded_violation": art["violation"],
+        "decision_log_sha256": sha,
+        "recorded_sha256": art["decision_log_sha256"],
+        "rounds": r.rounds,
+        "done": r.done,
+        "decision_log": log_text,
+        "match": (
+            violation == art["violation"] and sha == art["decision_log_sha256"]
+        ),
+    }
+
+
+def triage(
+    case: ReproCase, out_path: str, max_evals: int = MAX_EVALS, logger=None
+) -> dict:
+    """The sweep's failure hook: shrink the failing case and write its
+    repro artifact.  Returns the artifact dict."""
+    small, viol = shrink_case(case, max_evals=max_evals, logger=logger)
+    return save_artifact(out_path, small, viol)

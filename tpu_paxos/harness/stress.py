@@ -5,23 +5,32 @@ This is the framework acting as what the reference sets out to be —
 "verify the whole system behaviour under different simulated
 circumstances like network failure and process crash" (ref README) —
 beyond the fixed-seed pytest scenarios: each sweep samples fresh
-seeds against a grid of fault mixes (including crashes and in-order
-gate chains) and asserts agreement, exactly-once, executed-identical,
-in-order clients, and quiescence on every run.
+seeds against a grid of fault mixes (including crashes, in-order gate
+chains, and correlated-fault *episode* schedules — partition flaps,
+one-way link cuts, node pauses, burst loss; core/faults.py) and
+asserts agreement, exactly-once, executed-identical, in-order
+clients, and quiescence on every run.
+
+Failure triage: with ``--triage-dir`` (or ``triage_dir=``), any
+failing seed is handed to ``harness/shrink.py`` — the fault schedule
+is greedily shrunk to a minimal still-failing case and written as a
+JSON repro artifact that ``python -m tpu_paxos repro <artifact>``
+re-executes byte-identically.
 
 Engine shapes are held fixed per fault mix so each mix compiles once
 and every seed reuses the executable (the seed only changes the PRNG
 root, a runtime argument).
 
-CLI: ``python -m tpu_paxos.harness.stress [--seeds N] [--base-seed S]``
-(or ``make stress``) prints one JSON summary line and exits non-zero
-on any violation.
+CLI: ``python -m tpu_paxos.harness.stress [--seeds N] [--base-seed S]
+[--triage-dir D]`` (or ``make stress`` / ``make stress-quick``) prints
+one JSON summary line and exits non-zero on any violation.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import zlib
@@ -30,15 +39,48 @@ import jax
 import numpy as np
 
 from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
 from tpu_paxos.core import sim as simm
 from tpu_paxos.core import values as val
+from tpu_paxos.harness import shrink as shr
 from tpu_paxos.harness import validate
 from tpu_paxos.utils import log as logm
+
+# Correlated-fault schedules for the episode mixes (5-node clusters).
+# Every episode heals; convergence is owed (and asserted) after the
+# last heal with a full max_rounds budget (SimConfig.round_budget).
+SCHED_PARTITION_FLAP = flt.FaultSchedule((
+    # flapping bisections: each window isolates a different minority
+    flt.partition(6, 26, (0, 1), (2, 3, 4)),
+    flt.partition(40, 62, (0, 2, 4), (1, 3)),
+    flt.partition(76, 96, (1, 4), (0, 2, 3)),
+))
+SCHED_ONE_WAY = flt.FaultSchedule((
+    # asymmetric cuts, overlapping: 0 can still hear 2/3 but not talk
+    # to them, then 0 goes reply-deaf to 3/4, then 1 goes send-dark
+    flt.one_way(5, 30, (0,), (2, 3)),
+    flt.one_way(22, 48, (3, 4), (0,)),
+    flt.one_way(60, 80, (1,), (2, 3, 4)),
+))
+SCHED_PAUSE_HEAVY = flt.FaultSchedule((
+    # rolling GC-style pauses (incl. proposer node 1) + a loss burst
+    flt.pause(4, 26, 1),
+    flt.pause(18, 44, 3),
+    flt.pause(34, 58, 4),
+    flt.burst(10, 30, 2500),
+))
+SCHED_PAUSE_CRASH = flt.FaultSchedule((
+    # pauses on top of i.i.d. fail-stop crashes: the engine must keep
+    # pause- and crash-excusals apart (a paused node's obligations
+    # resume; a crashed node's never do)
+    flt.pause(6, 30, 1),
+    flt.pause(36, 60, 2),
+))
 
 # Fault mixes: (label, FaultConfig kwargs, n_nodes, n_proposers).
 # Rates are per-1e4 (drop/dup) and per-1e6 (crash), as in the
 # reference's debug.conf (ref multi/main.cpp:51-162,
-# member/indet.h:146-150).
+# member/indet.h:146-150); ``schedule`` adds the correlated layer.
 MIXES = [
     ("clean", dict(), 3, 1),
     ("debug.conf", dict(drop_rate=500, dup_rate=1000, max_delay=2), 5, 2),
@@ -56,7 +98,46 @@ MIXES = [
         7,
         2,
     ),
+    (
+        "partition-flap",
+        dict(
+            drop_rate=300, dup_rate=500, max_delay=2,
+            schedule=SCHED_PARTITION_FLAP,
+        ),
+        5,
+        2,
+    ),
+    (
+        "one-way",
+        dict(
+            drop_rate=300, dup_rate=500, max_delay=2,
+            schedule=SCHED_ONE_WAY,
+        ),
+        5,
+        2,
+    ),
+    (
+        "pause-heavy",
+        dict(
+            drop_rate=200, dup_rate=500, max_delay=2,
+            schedule=SCHED_PAUSE_HEAVY,
+        ),
+        5,
+        2,
+    ),
+    (
+        "pause-crash",
+        dict(
+            drop_rate=500, dup_rate=1000, max_delay=2, crash_rate=3000,
+            schedule=SCHED_PAUSE_CRASH,
+        ),
+        5,
+        2,
+    ),
 ]
+# The correlated-fault mixes (used by sweep_sharded and the episode
+# smoke) — derived structurally so reordering MIXES cannot drift it.
+EPISODE_MIXES = [m for m in MIXES if "schedule" in m[1]]
 
 N_IDS = 6  # ids per client chain (gated, in-order)
 N_FREE = 8  # ungated values per proposer
@@ -86,47 +167,30 @@ def _workload(n_prop: int, rng: np.random.Generator):
     return workload, gates, chains
 
 
-def _validate_run(r, cfg: SimConfig, workload, chains) -> None:
-    """Full invariant suite, crash-aware: liveness is only owed to
-    values whose proposer survived (the engine's own contract — a
-    crashed proposer's undrained queue is legitimately lost, cf.
-    tests/test_sim.py::test_crash_minority_safety_and_liveness);
-    safety (agreement, executed-identical, at-most-once, only-workload
-    values) holds unconditionally."""
-    crashed_props = [
-        i for i, node in enumerate(cfg.proposers) if r.crashed[node]
-    ]
-    full = np.unique(np.concatenate(workload))
-    if not crashed_props:
-        seqs = validate.check_all(r.learned, full)
-    else:
-        validate.check_agreement(r.learned)
-        seqs = validate.check_executed_identical(r.learned)
-        validate.check_exactly_once(r.learned, None)  # at most once
-        chosen = r.chosen_vid[r.chosen_vid >= 0]
-        extra = np.setdiff1d(chosen, full)
-        if extra.size:
-            raise validate.InvariantViolation(
-                f"non-workload values chosen: {extra[:8].tolist()}"
-            )
-        live_expected = np.unique(
-            np.concatenate(
-                [w for i, w in enumerate(workload) if i not in crashed_props]
-            )
+# Crash-aware invariant suite — shared with the shrinker so a shrunk
+# repro artifact is judged by exactly the sweep's rules.  Kept as a
+# module-level name: tests monkeypatch it to inject failures.
+_validate_run = shr.validate_run
+
+
+def _check_run(r, cfg: SimConfig, workload, chains) -> None:
+    """Quiescence (excused only when every proposer crashed — nobody
+    is left to close the log) + the crash-aware suite; mirrors
+    shrink.check_run through the patchable ``_validate_run`` seam."""
+    all_props_crashed = all(r.crashed[node] for node in cfg.proposers)
+    if not r.done and not all_props_crashed:
+        raise validate.InvariantViolation(
+            f"no quiescence in {r.rounds} rounds"
         )
-        missing = np.setdiff1d(live_expected, chosen)
-        if missing.size:
-            raise validate.InvariantViolation(
-                f"surviving proposers' values never chosen: "
-                f"{missing[:8].tolist()}"
-            )
-    live_chains = [
-        ch for i, ch in enumerate(chains) if i not in crashed_props
-    ]
-    validate.check_in_order_clients(max(seqs, key=len), live_chains)
+    _validate_run(r, cfg, workload, chains)
 
 
-def sweep(n_seeds: int = 8, base_seed: int = 0, verbose: bool = True) -> dict:
+def sweep(
+    n_seeds: int = 8,
+    base_seed: int = 0,
+    verbose: bool = True,
+    triage_dir: str | None = None,
+) -> dict:
     logger = logm.get_logger(
         "stress", logm.parse_level("INFO" if verbose else "WARN")
     )
@@ -157,7 +221,7 @@ def sweep(n_seeds: int = 8, base_seed: int = 0, verbose: bool = True) -> dict:
                 )
 
                 @jax.jit
-                def go(root, st, _round_fn=round_fn, _mr=cfg.max_rounds):
+                def go(root, st, _round_fn=round_fn, _mr=cfg.round_budget):
                     return jax.lax.while_loop(
                         lambda x: (~x.done) & (x.t < _mr),
                         lambda x: _round_fn(root, x),
@@ -171,18 +235,30 @@ def sweep(n_seeds: int = 8, base_seed: int = 0, verbose: bool = True) -> dict:
             )
             runs += 1
             try:
-                if not r.done:
-                    raise validate.InvariantViolation(
-                        f"no quiescence in {r.rounds} rounds"
-                    )
-                _validate_run(r, cfg, workload, chains)
+                _check_run(r, cfg, workload, chains)
             except validate.InvariantViolation as e:
-                failures.append(
-                    {"mix": label, "seed": seed, "error": str(e)[:300]}
-                )
+                failure = {"mix": label, "seed": seed, "error": str(e)[:300]}
                 logger.error("FAIL mix=%s seed=%d: %s", label, seed, e)
+                if triage_dir:
+                    # shrink the failing case to a minimal schedule and
+                    # pin it as a one-command repro artifact
+                    os.makedirs(triage_dir, exist_ok=True)
+                    path = os.path.join(
+                        triage_dir, f"repro_{label}_{seed}.json"
+                    )
+                    try:
+                        case = shr.ReproCase(
+                            cfg=cfg, workload=workload, gates=gates,
+                            chains=chains,
+                        )
+                        shr.triage(case, path, logger=logger)
+                        failure["artifact"] = path
+                        logger.error("repro artifact written to %s", path)
+                    except Exception as te:  # triage must never mask a failure
+                        failure["triage_error"] = str(te)[:300]
+                failures.append(failure)
         logger.info(
-            "mix %-11s: %d seeds done (cumulative %d runs, %d failures)",
+            "mix %-14s: %d seeds done (cumulative %d runs, %d failures)",
             label, n_seeds, runs, len(failures),
         )
     return {
@@ -199,11 +275,12 @@ def sweep(n_seeds: int = 8, base_seed: int = 0, verbose: bool = True) -> dict:
 def sweep_sharded(
     n_seeds: int = 2, base_seed: int = 0, verbose: bool = True
 ) -> dict:
-    """The debug.conf and crashy mixes through the SHARDED engine on
-    the current device mesh (run under a virtual multi-device CPU
-    backend via ``--sharded``, which re-execs in a clean subprocess).
-    Chains stay shard-affine via split_workload, so the same
-    crash-aware invariant suite applies."""
+    """The debug.conf and crashy mixes PLUS every episode mix through
+    the SHARDED engine on the current device mesh (run under a virtual
+    multi-device CPU backend via ``--sharded``, which re-execs in a
+    clean subprocess).  Chains stay shard-affine via split_workload,
+    so the same crash-aware invariant suite applies; episode schedules
+    are compile-time constants replicated across shards."""
     import jax
 
     from tpu_paxos.parallel import mesh as pmesh
@@ -215,7 +292,7 @@ def sweep_sharded(
     mesh = pmesh.make_instance_mesh()
     runs, failures = 0, []
     t0 = time.perf_counter()
-    for label, fkw, n_nodes, n_prop in (MIXES[1], MIXES[4]):
+    for label, fkw, n_nodes, n_prop in (MIXES[1], MIXES[4], *EPISODE_MIXES):
         for s in range(n_seeds):
             seed = base_seed + s
             rng = np.random.default_rng(
@@ -238,11 +315,7 @@ def sweep_sharded(
             r = sharded_sim.run_sharded(cfg, mesh, workload, gates)
             runs += 1
             try:
-                if not r.done:
-                    raise validate.InvariantViolation(
-                        f"no quiescence in {r.rounds} rounds"
-                    )
-                _validate_run(r, cfg, workload, chains)
+                _check_run(r, cfg, workload, chains)
             except validate.InvariantViolation as e:
                 failures.append(
                     {"mix": label, "seed": seed, "error": str(e)[:300]}
@@ -270,8 +343,18 @@ def main(argv=None) -> int:
         help="also run the sharded engine on an 8-device virtual CPU "
         "mesh (subprocess)",
     )
+    ap.add_argument(
+        "--triage-dir",
+        type=str,
+        default="",
+        help="on any failing seed, shrink the fault schedule to a "
+        "minimal failing case and write a repro artifact here "
+        "(replay with `python -m tpu_paxos repro <artifact>`)",
+    )
     args = ap.parse_args(argv)
-    summary = sweep(args.seeds, args.base_seed)
+    summary = sweep(
+        args.seeds, args.base_seed, triage_dir=args.triage_dir or None
+    )
     print(json.dumps(summary))
     ok = summary["ok"]
     if args.sharded:

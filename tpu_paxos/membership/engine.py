@@ -71,6 +71,8 @@ benchmarks carry the throughput story.
 
 from __future__ import annotations
 
+import hashlib
+import os
 from typing import NamedTuple
 
 import jax
@@ -105,6 +107,16 @@ ACCEPT_STALE_ROUNDS = 4  # restart prepare if a batch stalls this long
 # proposer crashed mid-accept.  The fresh prepare's adoption re-accepts
 # the orphan and no-op fill plugs the hole.
 REPAIR_STALL_ROUNDS = 8
+
+
+def _file_sha256(path) -> str:
+    """Content hash of a checkpoint artifact — pins a rejoin's input
+    file in the injection log so replay can detect a swapped file."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def change_vid(node: int, kind: int) -> int:
@@ -216,13 +228,43 @@ def _init(n: int, i: int, c: int) -> MemberState:
     )
 
 
-def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 0):
+def _build_round(
+    n: int,
+    i_cap: int,
+    c: int,
+    root: jax.Array,
+    crash_rate: int = 0,
+    comp=None,
+):
+    """``comp`` is a compiled fault schedule (core/faults.py) or None.
+    member/'s network is synchronous — request and reply happen in one
+    step — so an edge functions only when reachability holds in BOTH
+    directions; one-way cuts therefore sever the whole exchange on the
+    affected edges (the asymmetric-delivery story belongs to the
+    calendar network of core/sim).  Pauses subtract from the alive
+    mask like crashes but preserve state and heal at episode end."""
     idx = jnp.arange(i_cap, dtype=jnp.int32)
     rows = jnp.arange(n)
+    horizon = comp.horizon if comp is not None else 0
+    pause_tab = (
+        jnp.asarray(comp.paused) if comp is not None and comp.has_pause else None
+    )
+    reach_tab = (
+        jnp.asarray(comp.reach) if comp is not None and comp.has_reach else None
+    )
 
     def round_fn(st: MemberState) -> MemberState:
         t = st.t
-        alive = ~st.crashed  # [N]
+        tt = jnp.minimum(t, jnp.int32(horizon)) if comp is not None else None
+        exist = ~st.crashed  # [N] not-crashed (excusals key off this)
+        alive = exist  # [N] I/O-alive: crashed or paused act in no role
+        if pause_tab is not None:
+            alive = alive & ~pause_tab[tt]
+        if reach_tab is not None:
+            reach_t = reach_tab[tt]
+            reach2_t = reach_t & reach_t.T  # synchronous exchange
+        else:
+            reach_t = reach2_t = None
         # node-local roles (a node acts on its OWN view of itself;
         # crashed nodes act in no role)
         is_prop = st.proposers[rows, rows] & alive  # [N]
@@ -244,6 +286,8 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
             & is_accp[None, :]
             & (st.version[:, None] == st.version[None, :])
         )  # [V, A]
+        if reach2_t is not None:
+            edge = edge & reach2_t
         elig = edge & (st.ballot[:, None] >= st.promised[None, :])
         max_seen = jnp.maximum(
             st.max_seen,
@@ -331,8 +375,10 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
                 le_v = (
                     inst_chosen[v][:, None]
                     & st.learners[v][None, :]
-                    & alive[None, :]  # crashed learners learn nothing
+                    & alive[None, :]  # crashed/paused learners learn nothing
                 )  # [I, L]
+                if reach_t is not None:
+                    le_v = le_v & reach_t[v][None, :]
                 lbest = jnp.maximum(
                     lbest,
                     jnp.where(le_v, st.cur_batch[v][:, None], _NEG),
@@ -372,6 +418,8 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
         donor_ok = (
             (l_at_f != val.NONE) & st.learners.T & alive[None, :]  # [nn, m]
         )
+        if reach_t is not None:
+            donor_ok = donor_ok & reach_t.T  # pull rides an m -> nn send
         can_pull = jnp.any(donor_ok, axis=1) & (mine == val.NONE) & alive
         pulled = jnp.max(jnp.where(donor_ok, l_at_f, _NEG), axis=1)
         learned = learned.at[f, rows].set(
@@ -550,6 +598,8 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
             & is_accp[None, :]
             & (version[:, None] == version[None, :])
         )
+        if reach2_t is not None:
+            pedge = pedge & reach2_t
         grant = pedge & (ballot[:, None] > st.promised[None, :])
         promised = jnp.maximum(
             st.promised, jnp.max(jnp.where(grant, ballot[:, None], bal.NONE), axis=0)
@@ -688,9 +738,13 @@ def _build_round(n: int, i_cap: int, c: int, root: jax.Array, crash_rate: int = 
         if crash_rate:
             ku = prng.stream(root, prng.STREAM_CRASH, t)
             u = jax.random.randint(ku, (n,), 0, 1_000_000)
-            want = (u < crash_rate) & alive
+            # admission works over the not-crashed mask (`exist`), NOT
+            # the I/O-alive one: a paused node resumes, so it still
+            # counts toward live majorities and must never be folded
+            # into the crash set by the `~alive_c` complement below
+            want = (u < crash_rate) & exist
             qv_new = jnp.sum(acceptors_v, axis=1, dtype=jnp.int32) // 2 + 1
-            alive_c = alive
+            alive_c = exist
             for x in range(1, n):
                 still = alive_c & (rows != x)
                 live_acc = jnp.sum(
@@ -748,14 +802,21 @@ class MemberSim:
         n_instances: int,
         seed: int = 0,
         crash_rate: int = 0,
+        schedule=None,
     ):
+        from tpu_paxos.core import faults as fltm
+
         self.n = n_nodes
         self.i = n_instances
         self.c = n_instances * 2 + 8
         self.root = prng.root_key(seed)
         self.state = _init(n_nodes, n_instances, self.c)
+        self.schedule = schedule  # FaultSchedule | None (core/faults.py)
+        comp = fltm.compile_schedule(schedule, n_nodes)
         self._round = jax.jit(
-            _build_round(n_nodes, n_instances, self.c, self.root, crash_rate)
+            _build_round(
+                n_nodes, n_instances, self.c, self.root, crash_rate, comp
+            )
         )
         # Injection log: every (round, op, args) a host driver feeds
         # in.  The engine itself is a pure function of (seed, round),
@@ -773,6 +834,10 @@ class MemberSim:
             "n_instances": n_instances,
             "seed": seed,
             "crash_rate": crash_rate,
+            # the episode schedule is part of the run's deterministic
+            # identity — a replay must re-inject the same partitions/
+            # pauses or the engine diverges from the recorded log
+            "schedule": schedule.to_dict() if schedule is not None else None,
         }
         self.injections: list[list] = []
         self.crash_rate = crash_rate
@@ -1059,10 +1124,25 @@ class MemberSim:
         self.state = st._replace(**kw)
         self._crash_round.pop(node, None)
         # Replaying a rejoin needs the checkpoint artifact to still
-        # exist at the recorded path (the engine re-derives the same
-        # state, but the restore step reads the file).
+        # exist at the recorded path — and to still be the SAME file:
+        # the injection log pins its sha256 and geometry at record
+        # time, and replay() verifies both before restoring, so a
+        # moved/rewritten checkpoint fails loudly instead of silently
+        # diverging from the recorded run.
         self.injections.append(
-            [int(st.t), "rejoin", [int(node), str(path)]]
+            [
+                int(st.t),
+                "rejoin",
+                [
+                    int(node),
+                    str(path),
+                    {
+                        "sha256": _file_sha256(path),
+                        "n_nodes": self.n,
+                        "n_instances": self.i,
+                    },
+                ],
+            ]
         )
 
     # -- host-injection record / replay (component 9's escape hatch;
@@ -1091,19 +1171,27 @@ class MemberSim:
         """Re-execute a recorded run: same engine seed, every injection
         applied at the recorded round, stepped to the recorded final
         round.  The result is bit-identical to the recorded run (the
-        engine is deterministic in (seed, round); the log supplies the
-        host's side), decision_log() byte-compares equal."""
+        engine is deterministic in (seed, round, schedule); the log
+        supplies the host's side), decision_log() byte-compares equal."""
         import json
+
+        from tpu_paxos.core import faults as fltm
 
         with open(path) as f:
             log = json.load(f)
         if log.get("version") != 1:
             raise ValueError(f"unknown injection-log version {log.get('version')}")
+        sched = (
+            fltm.FaultSchedule.from_dict(log["schedule"])
+            if log.get("schedule")
+            else None
+        )
         ms = cls(
             n_nodes=log["n_nodes"],
             n_instances=log["n_instances"],
             seed=log["seed"],
             crash_rate=log["crash_rate"],
+            schedule=sched,
         )
         for t_op, op, args in log["ops"]:
             if int(ms.state.t) > t_op:
@@ -1118,7 +1206,39 @@ class MemberSim:
             elif op == "crash":
                 ms.crash(*args)
             elif op == "rejoin":
-                ms.rejoin_from_checkpoint(*args)
+                # Integrity gate BEFORE restoring: the recorded run pinned
+                # the checkpoint's content hash and geometry; a replay
+                # against a moved/rewritten/misconfigured file must fail
+                # with a named cause, not diverge silently.  (Logs from
+                # before the pinning carry 2-element args; those replay
+                # unverified, as recorded.)
+                node, ck_path = args[0], args[1]
+                if len(args) > 2 and args[2]:
+                    meta = args[2]
+                    if not os.path.exists(ck_path):
+                        raise ValueError(
+                            f"rejoin checkpoint {ck_path!r} missing at "
+                            "replay time"
+                        )
+                    got = _file_sha256(ck_path)
+                    if got != meta.get("sha256"):
+                        raise ValueError(
+                            f"rejoin checkpoint {ck_path!r} sha256 "
+                            f"{got[:16]}... != recorded "
+                            f"{str(meta.get('sha256'))[:16]}... — the file "
+                            "changed since the run was recorded"
+                        )
+                    if (
+                        meta.get("n_nodes") != ms.n
+                        or meta.get("n_instances") != ms.i
+                    ):
+                        raise ValueError(
+                            "rejoin checkpoint geometry "
+                            f"({meta.get('n_nodes')} nodes x "
+                            f"{meta.get('n_instances')} instances) does not "
+                            f"match the replayed run ({ms.n} x {ms.i})"
+                        )
+                ms.rejoin_from_checkpoint(node, ck_path)
             else:
                 raise ValueError(f"unknown op {op!r} in injection log")
         while int(ms.state.t) < log["final_t"]:

@@ -37,6 +37,26 @@ INSTANCE_AXIS = "i"
 DCN_AXIS = "dcn"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """Version-portable ``shard_map`` without replication checking
+    (the round functions assert their own replication invariants; the
+    checker's conservative analysis rejects the cond-gated
+    collectives).  New jax exposes ``jax.shard_map(check_vma=...)``;
+    older releases only have the experimental module with
+    ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def make_instance_mesh(
     n_devices: int | None = None, devices=None, dcn_hosts: int = 1
 ) -> Mesh:

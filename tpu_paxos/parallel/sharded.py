@@ -26,6 +26,7 @@ from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import fast
 from tpu_paxos.core import values as val
 from tpu_paxos.parallel.mesh import INSTANCE_AXIS, instance_axes
+from tpu_paxos.parallel.mesh import shard_map as pmesh_shard_map
 
 
 def _state_specs(axes=INSTANCE_AXIS) -> fast.FastState:
@@ -75,12 +76,11 @@ def sharded_choose_all(mesh: Mesh, proposer: int, quorum: int):
     body = functools.partial(
         _choose_all_local, proposer=proposer, quorum=quorum, axes=axes
     )
-    mapped = jax.shard_map(
+    mapped = pmesh_shard_map(
         body,
-        mesh=mesh,
+        mesh,
         in_specs=(_state_specs(axes), P(axes)),
         out_specs=(_state_specs(axes), P()),
-        check_vma=False,
     )
     return jax.jit(mapped)
 

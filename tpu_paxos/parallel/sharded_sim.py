@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_paxos.config import SimConfig
 from tpu_paxos.core import sim as simm
 from tpu_paxos.core import values as val
+from tpu_paxos.parallel import mesh as pmesh
 from tpu_paxos.parallel.mesh import INSTANCE_AXIS, instance_axes
 from tpu_paxos.utils import prng
 
@@ -306,7 +307,7 @@ def build_runner(
         st = _unwrap(st)
 
         def cond(s):
-            return (~s.done) & (s.t < cfg.max_rounds)
+            return (~s.done) & (s.t < cfg.round_budget)
 
         def step(s):
             return round_fn(root, s)
@@ -315,12 +316,11 @@ def build_runner(
 
     specs = _state_specs(axes)
     mapped = jax.jit(
-        jax.shard_map(
+        pmesh.shard_map(
             body,
-            mesh=mesh,
+            mesh,
             in_specs=(P(), specs),
             out_specs=specs,
-            check_vma=False,
         )
     )
     expected = np.unique(

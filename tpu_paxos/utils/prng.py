@@ -14,6 +14,15 @@ from __future__ import annotations
 
 import jax
 
+# Pin the threefry implementation: partitionable counter-based keys.
+# The flag CHANGES THE SAMPLED VALUES, so it is part of the engine's
+# determinism contract — a repro artifact or injection log recorded
+# under one setting must replay identically in any host (pytest's
+# conftest sets True; older jax defaults False — without this pin the
+# same seed produced different runs in-process vs via the CLI).  Also
+# required for identical streams across shard counts on a mesh.
+jax.config.update("jax_threefry_partitionable", True)
+
 # Stable stream tags (fold_in indices). Adding a stream = appending here.
 STREAM_PREPARE_DELAY = 0
 STREAM_NET_DROP = 1
