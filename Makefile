@@ -4,8 +4,9 @@
 
 PY ?= python
 
-.PHONY: test test-slow check bench bench-sharded parity parity-fast \
-	replay-diff replay-diff-member run stress stress-quick clean
+.PHONY: test test-slow check lint lint-json bench bench-sharded parity \
+	parity-fast replay-diff replay-diff-member run stress stress-quick \
+	clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -15,12 +16,22 @@ test:
 test-slow:
 	$(PY) -m pytest tests/ -x -q
 
+# paxlint: determinism & JAX-purity static analysis
+# (tpu_paxos/analysis/).  Pure-AST — runs without jax, in seconds.
+# Exit 0 iff zero unsuppressed findings and no stale baseline entries.
+lint:
+	$(PY) -m tpu_paxos lint
+
+lint-json:
+	$(PY) -m tpu_paxos lint --json
+
 # Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
-# fast tier with NaN-checking on, then an un-jitted op-by-op smoke of
-# one tiny config per engine (every cond predicate, slice bound, and
-# dtype materializes eagerly).  The pallas interpreter path is part of
-# the fast tier (tests/test_fastwin.py).
-check:
+# static analyzers first (cheapest signal), then the fast tier with
+# NaN-checking on, then an un-jitted op-by-op smoke of one tiny config
+# per engine (every cond predicate, slice bound, and dtype
+# materializes eagerly).  The pallas interpreter path is part of the
+# fast tier (tests/test_fastwin.py).
+check: lint
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 

@@ -11,9 +11,12 @@ initialization (first device/array use).
 import os
 
 import jax
+import pytest
 
+# paxlint: allow[DET004] platform selection for the test mesh, value-neutral
 jax.config.update("jax_platforms", "cpu")
 try:
+    # paxlint: allow[DET004] device provisioning, value-neutral
     jax.config.update("jax_num_cpu_devices", 8)
 except AttributeError:
     # older jax: the option landed after 0.4.x; the XLA flag does the
@@ -23,3 +26,99 @@ except AttributeError:
         + " --xla_force_host_platform_device_count=8"
     )
 jax.config.update("jax_threefry_partitionable", True)
+
+# ---- compile-census guard (tpu_paxos/analysis/tracecount.py) ----
+# Counts every XLA compilation and attributes it to the test module
+# that triggered it; pytest_sessionfinish enforces the pinned
+# per-module budget for full tier-1-shaped runs, so a retrace
+# regression fails CI with a named culprit instead of just slowing
+# the suite down.
+from tpu_paxos.analysis import tracecount  # noqa: E402
+
+_census = tracecount.CompileCensus().start()
+
+
+def pytest_runtest_setup(item):
+    _census.set_label(item.location[0])
+
+
+@pytest.fixture
+def compile_census():
+    """The session's live CompileCensus (tests can read .counts or
+    run their own scoped census on top — listeners stack)."""
+    return _census
+
+
+def _census_applicable(config) -> bool:
+    """Budgets were pinned from the tier-1 suite (-m 'not slow', no
+    -k, default compile options): only an equivalent selection
+    produces comparable counts — in-process jit caches make module
+    counts order-dependent, and debug modes compile different
+    programs."""
+    return (
+        getattr(config.option, "markexpr", "") == "not slow"
+        and not getattr(config.option, "keyword", "")
+        and not os.environ.get("JAX_DEBUG_NANS")
+        and not os.environ.get("JAX_DISABLE_JIT")
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    pin = os.environ.get("TPU_PAXOS_COMPILE_CENSUS_PIN")
+    if pin:
+        # re-pin the budget from this run's measured counts (the
+        # intentional-change workflow; see README) — but only from a
+        # run whose counts a future tier-1 session will actually be
+        # comparable to: passing, tier-1-shaped, default compile opts
+        if exitstatus != 0 or not _census_applicable(session.config):
+            print(
+                f"\ncompile census NOT pinned to {pin}: pinning needs "
+                "a PASSING tier-1-shaped run (-m 'not slow', no -k, "
+                "no debug-NaNs/disable-jit) — partial or failing "
+                "sessions measure different jit-cache state"
+            )
+            return
+        tracecount.save_budget(_census.counts, pin, visited=_census.visited)
+        print(
+            f"\ncompile census pinned to {pin} "
+            f"({len(_census.visited)} modules visited)"
+        )
+        print(_census.report())
+        return
+    budget = tracecount.load_budget(
+        os.environ.get("TPU_PAXOS_COMPILE_BUDGET", tracecount.DEFAULT_BUDGET)
+    )
+    if not budget:
+        return
+    forced = os.environ.get("TPU_PAXOS_COMPILE_CENSUS", "") == "1"
+    if not _census.should_enforce(budget):
+        # a tier-1-shaped run that still can't enforce means budgeted
+        # modules were never visited (renamed/deleted/slow-marked):
+        # say so — a silently disarmed guard is how regressions land
+        # (test_tracecount also fails on budget entries whose file is
+        # gone, so CI stays red until the budget is re-pinned)
+        whole_suite = getattr(
+            session.config.option, "file_or_dir", []
+        ) in ([], ["tests"], ["tests/"])
+        # only warn for PASSING whole-suite runs: a failed -x session
+        # skips later modules for a reason the failure already explains
+        if (exitstatus == 0 and whole_suite
+                and _census_applicable(session.config)):
+            missing = sorted(set(budget.get("budgets", {})) - _census.visited)
+            if missing:
+                print(
+                    "\ncompile-census NOT enforced: budgeted modules "
+                    f"never visited this run: {', '.join(missing[:5])}"
+                    f"{' …' if len(missing) > 5 else ''} — re-pin "
+                    "compile_budget.json if they were renamed/removed"
+                )
+        return
+    if not forced and not _census_applicable(session.config):
+        return
+    violations = _census.check_budget(budget)
+    if violations:
+        print("\ncompile-census budget EXCEEDED:")
+        for v in violations:
+            print(f"  {v}")
+        print(_census.report())
+        session.exitstatus = 1

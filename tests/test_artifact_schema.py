@@ -1,0 +1,295 @@
+"""Repro-artifact schema validation (analysis/artifact_schema.py):
+well-formed artifacts pass, every class of corruption fails with an
+error naming the offending field, and the check is wired into
+``load_artifact`` (the ``python -m tpu_paxos repro`` load path)."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from tpu_paxos.analysis.artifact_schema import (
+    ARTIFACT_FORMAT,
+    ArtifactSchemaError,
+    validate_artifact,
+)
+
+
+def valid_artifact() -> dict:
+    """Structurally identical to harness/shrink.save_artifact output
+    (tests/test_shrink.py covers the real producer end-to-end; this
+    literal keeps the schema tests engine-free and fast)."""
+    return {
+        "format": ARTIFACT_FORMAT,
+        "cfg": {
+            "n_nodes": 3,
+            "n_instances": 16,
+            "proposers": [0, 1],
+            "seed": 7,
+            "max_rounds": 500,
+            "assign_window": 64,
+            "protocol": {
+                "prepare_delay_min": 0,
+                "prepare_delay_max": 4,
+                "prepare_retry_count": 3,
+                "prepare_retry_timeout": 2,
+                "accept_retry_count": 3,
+                "accept_retry_timeout": 2,
+                "commit_retry_timeout": 2,
+            },
+            "faults": {
+                "drop_rate": 500,
+                "dup_rate": 0,
+                "min_delay": 0,
+                "max_delay": 2,
+                "crash_rate": 0,
+                "schedule": {
+                    "episodes": [
+                        {
+                            "kind": "partition",
+                            "t0": 4,
+                            "t1": 9,
+                            "groups": [[0], [1, 2]],
+                            "src": [],
+                            "dst": [],
+                            "nodes": [],
+                            "drop_rate": 0,
+                        },
+                        {
+                            "kind": "burst",
+                            "t0": 0,
+                            "t1": 3,
+                            "groups": [],
+                            "src": [],
+                            "dst": [],
+                            "nodes": [],
+                            "drop_rate": 2500,
+                        },
+                    ]
+                },
+            },
+        },
+        "workload": [[100, 101], [200]],
+        "gates": None,
+        "chains": [[100, 101]],
+        "extra_checks": {"decision_round_max": 40},
+        "violation": "no quiescence in 500 rounds",
+        "decision_log_sha256": "ab" * 32,
+        "rounds": 500,
+    }
+
+
+def _expect_field(art, field):
+    with pytest.raises(ArtifactSchemaError) as ei:
+        validate_artifact(art)
+    assert ei.value.field == field, (
+        f"expected error at {field!r}, got {ei.value.field!r}: {ei.value}"
+    )
+
+
+def test_valid_artifact_passes():
+    validate_artifact(valid_artifact())
+
+
+def test_schedule_null_ok():
+    art = valid_artifact()
+    art["cfg"]["faults"]["schedule"] = None
+    validate_artifact(art)
+
+
+def test_missing_required_field_named():
+    art = valid_artifact()
+    del art["decision_log_sha256"]
+    _expect_field(art, "decision_log_sha256")
+
+
+def test_wrong_type_named():
+    art = valid_artifact()
+    art["cfg"]["seed"] = "seven"
+    _expect_field(art, "cfg.seed")
+
+
+def test_bool_is_not_int():
+    art = valid_artifact()
+    art["cfg"]["n_nodes"] = True
+    _expect_field(art, "cfg.n_nodes")
+
+
+def test_negative_rate_named():
+    art = valid_artifact()
+    art["cfg"]["faults"]["drop_rate"] = -3
+    _expect_field(art, "cfg.faults.drop_rate")
+
+
+def test_nested_episode_field_named():
+    art = valid_artifact()
+    art["cfg"]["faults"]["schedule"]["episodes"][1]["kind"] = "meteor"
+    _expect_field(art, "cfg.faults.schedule.episodes[1].kind")
+
+
+def test_workload_element_named():
+    art = valid_artifact()
+    art["workload"][1] = [200, "two-oh-one"]
+    _expect_field(art, "workload[1][1]")
+
+
+def test_unknown_key_in_closed_struct_named():
+    # a hand-edit typo ('node' for 'nodes') must be named by the
+    # schema, not die later as Episode's bare ValueError
+    art = valid_artifact()
+    ep = art["cfg"]["faults"]["schedule"]["episodes"][0]
+    ep["node"] = ep.pop("nodes")
+    _expect_field(art, "cfg.faults.schedule.episodes[0].node")
+
+
+def test_unknown_key_under_faults_named():
+    art = valid_artifact()
+    art["cfg"]["faults"]["drop_rte"] = 5
+    _expect_field(art, "cfg.faults.drop_rte")
+
+
+def test_extra_checks_stays_open():
+    art = valid_artifact()
+    art["extra_checks"]["some_future_check"] = {"x": 1}
+    validate_artifact(art)
+
+
+def test_bad_sha256_named():
+    art = valid_artifact()
+    art["decision_log_sha256"] = "nothex"
+    _expect_field(art, "decision_log_sha256")
+
+
+def test_wrong_format_const():
+    art = valid_artifact()
+    art["format"] = "tpu-paxos-repro-99"
+    _expect_field(art, "format")
+
+
+def test_wrong_format_reaches_clean_cli_surface(tmp_path):
+    """A wrong/missing format flows through the schema (not a bare
+    ValueError), so load_artifact callers get the field-named error
+    and ``repro`` its clean exit 2."""
+    from tpu_paxos.harness import shrink
+
+    for mutate in (lambda a: a.__setitem__("format", "tpu-paxos-repro-0"),
+                   lambda a: a.pop("format")):
+        art = valid_artifact()
+        mutate(art)
+        path = tmp_path / "fmt.json"
+        path.write_text(json.dumps(art))
+        with pytest.raises(ArtifactSchemaError) as ei:
+            shrink.load_artifact(str(path))
+        assert ei.value.field == "format"
+
+
+def test_cross_field_proposer_range():
+    art = valid_artifact()
+    art["cfg"]["proposers"] = [0, 5]
+    _expect_field(art, "cfg.proposers[1]")
+
+
+def test_cross_field_workload_arity():
+    art = valid_artifact()
+    art["workload"] = [[1]]
+    _expect_field(art, "workload")
+
+
+def test_cross_field_gates_arity():
+    art = valid_artifact()
+    art["gates"] = [[-1, -1]]
+    _expect_field(art, "gates")
+
+
+def test_load_artifact_applies_schema(tmp_path):
+    """The repro load path rejects a corrupt artifact with the field
+    name AND the file path in the message (the user-facing surface)."""
+    from tpu_paxos.harness import shrink
+
+    art = valid_artifact()
+    art["cfg"]["faults"]["schedule"]["episodes"][0]["t0"] = -4
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(art))
+    with pytest.raises(ArtifactSchemaError) as ei:
+        shrink.load_artifact(str(path))
+    assert ei.value.field == "cfg.faults.schedule.episodes[0].t0"
+    assert "bad.json" in str(ei.value)
+
+
+def test_load_artifact_truncated_json_clean_error(tmp_path):
+    """A truncated artifact (killed stress run) surfaces as
+    ArtifactSchemaError — reaching repro's exit-2 path — not a raw
+    JSONDecodeError traceback."""
+    from tpu_paxos.harness import shrink
+
+    path = tmp_path / "trunc.json"
+    path.write_text(json.dumps(valid_artifact())[:57])
+    with pytest.raises(ArtifactSchemaError, match="invalid JSON"):
+        shrink.load_artifact(str(path))
+    with pytest.raises(ArtifactSchemaError, match="unreadable"):
+        shrink.load_artifact(str(tmp_path / "nonexistent.json"))
+
+
+def test_load_artifact_semantic_constraint_clean_error(tmp_path):
+    """Constraints enforced by the config/episode constructors beyond
+    the schema's type/range checks (here: an empty episode interval)
+    still surface as ArtifactSchemaError, not a raw ValueError."""
+    from tpu_paxos.harness import shrink
+
+    art = valid_artifact()
+    art["cfg"]["faults"]["schedule"]["episodes"][0]["t1"] = 4
+    art["cfg"]["faults"]["schedule"]["episodes"][0]["t0"] = 4
+    path = tmp_path / "empty_interval.json"
+    path.write_text(json.dumps(art))
+    with pytest.raises(ArtifactSchemaError, match="config validation"):
+        shrink.load_artifact(str(path))
+
+
+def test_load_artifact_accepts_valid(tmp_path):
+    from tpu_paxos.harness import shrink
+
+    path = tmp_path / "ok.json"
+    path.write_text(json.dumps(valid_artifact()))
+    case, art = shrink.load_artifact(str(path))
+    assert case.cfg.n_nodes == 3
+    assert art["format"] == ARTIFACT_FORMAT
+    # shrink re-exports the constant from the schema module
+    assert shrink.ARTIFACT_FORMAT == ARTIFACT_FORMAT
+
+
+def test_repro_cli_exit_code_on_schema_error(tmp_path, monkeypatch):
+    """``python -m tpu_paxos repro <bad>`` exits 2 with a JSON
+    summary naming the field (in-process: backend=auto is a no-op)."""
+    from tpu_paxos import __main__ as cli
+
+    # pre-set the flag so run_repro's setdefault leaves it alone and
+    # monkeypatch teardown restores the ORIGINAL state (a trailing
+    # delenv would record run_repro's "1" and re-set it session-wide)
+    monkeypatch.setenv("TPU_PAXOS_DETERMINISTIC", "0")
+    art = valid_artifact()
+    art["rounds"] = -1
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(art))
+    rc = cli.run_repro([str(path), "--json"])
+    assert rc == 2
+
+
+def test_error_message_shape():
+    art = valid_artifact()
+    art["cfg"]["protocol"]["prepare_delay_max"] = None
+    try:
+        validate_artifact(art)
+    except ArtifactSchemaError as e:
+        assert "cfg.protocol.prepare_delay_max" in str(e)
+        assert "null" in e.problem
+    else:
+        raise AssertionError("expected ArtifactSchemaError")
+
+
+def test_deep_copy_safety():
+    # validation must not mutate the artifact it inspects
+    art = valid_artifact()
+    snapshot = copy.deepcopy(art)
+    validate_artifact(art)
+    assert art == snapshot

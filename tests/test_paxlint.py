@@ -1,0 +1,498 @@
+"""paxlint rule fixtures: one true-positive and one true-negative per
+rule, pragma/baseline mechanics, and the repo-is-clean contract.
+
+Every fixture is a tiny source snippet linted via
+``lint.lint_source`` (``replay_critical=True`` puts DET rules in
+scope without needing a package on disk).  The golden-JSON CLI test
+and the jax-free import guard live in ``test_paxlint_cli.py``."""
+
+import json
+import os
+
+import pytest
+
+from tpu_paxos.analysis import lint
+from tpu_paxos.analysis import rules_det  # noqa: F401  (registers RULES)
+from tpu_paxos.analysis import rules_jax  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src: str, **kw) -> list[str]:
+    return [f.rule for f in lint.lint_source(src, **kw)]
+
+
+# ---------------- DET001: wall-clock ----------------
+
+def test_det001_true_positive_replay_critical():
+    src = "import time\n\ndef stamp():\n    return time.time()\n"
+    assert rules_of(src) == ["DET001"]
+
+
+def test_det001_true_positive_sink_function_outside_closure():
+    # wall-clock formatted into written bytes is flagged even outside
+    # the replay-critical closure (the utils/log.py failure mode)
+    src = (
+        "import time\n\n"
+        "def log_line(stream, msg):\n"
+        "    stream.write(f'[{time.time()}] {msg}')\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["DET001"]
+
+
+def test_det001_true_negative_outside_scope():
+    # plain host timing in a non-sink function outside the closure
+    src = (
+        "import time\n\n"
+        "def elapsed():\n    return time.perf_counter()\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+# ---------------- DET002: unseeded randomness ----------------
+
+def test_det002_true_positive():
+    src = (
+        "import random\n\n"
+        "def backoff():\n    return random.random()\n"
+    )
+    assert rules_of(src) == ["DET002"]
+
+
+def test_det002_legacy_numpy_global_flagged():
+    src = "import numpy as np\n\ndef f():\n    return np.random.rand(3)\n"
+    assert rules_of(src) == ["DET002"]
+
+
+def test_det002_true_negative_seeded():
+    # the sanctioned patterns: jax.random streams, seeded Generators
+    src = (
+        "import jax\nimport numpy as np\n\n"
+        "def f(seed):\n"
+        "    k = jax.random.fold_in(jax.random.PRNGKey(seed), 3)\n"
+        "    return jax.random.uniform(k), np.random.default_rng(seed)\n"
+    )
+    assert rules_of(src) == []
+
+
+# ---------------- DET003: unordered iteration ----------------
+
+def test_det003_true_positive_set_iteration():
+    src = (
+        "def log_members(members):\n"
+        "    return ' '.join(str(m) for m in set(members))\n"
+    )
+    assert rules_of(src) == ["DET003"]
+
+
+def test_det003_repo_idiom_set_accessor():
+    src = (
+        "def dump(sim):\n"
+        "    return [x for x in sim.acceptor_set()]\n"
+    )
+    assert rules_of(src) == ["DET003"]
+
+
+def test_det003_true_negative_sorted():
+    src = (
+        "def log_members(members):\n"
+        "    return ' '.join(str(m) for m in sorted(set(members)))\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_true_negative_order_insensitive():
+    # reductions and membership tests never leak order
+    src = (
+        "def f(a, b):\n"
+        "    return len(set(a) & set(b)), min(set(a)), 3 in set(b)\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_dict_view_in_sink():
+    src = (
+        "import json\n\n"
+        "def emit(summary):\n"
+        "    print(json.dumps({k: v for k, v in summary.items()}))\n"
+    )
+    assert "DET003" in rules_of(src, replay_critical=False)
+
+
+def test_det003_dict_view_ok_outside_sink():
+    # insertion order is deterministic in-process; only flag when it
+    # escapes through a serialization sink
+    src = (
+        "def total(d):\n"
+        "    out = 0\n"
+        "    for k, v in d.items():\n        out += v\n"
+        "    return out\n"
+    )
+    assert rules_of(src) == []
+
+
+# ---------------- DET004: jax.config.update containment ----------------
+
+def test_det004_true_positive_anywhere():
+    src = (
+        "import jax\n\n"
+        "def setup():\n"
+        "    jax.config.update('jax_threefry_partitionable', False)\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["DET004"]
+
+
+def test_det004_true_negative_in_prng(tmp_path):
+    # the one sanctioned home; exercised on a real path layout
+    pkg = tmp_path / "tpu_paxos" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "prng.py").write_text(
+        "import jax\njax.config.update('jax_threefry_partitionable', True)\n"
+    )
+    findings = lint.lint_files(str(tmp_path), ["tpu_paxos/utils/prng.py"])
+    assert [f.rule for f in findings] == []
+
+
+# ---------------- JAX101: traced-value branching ----------------
+
+JIT_IF = (
+    "import jax\n\n"
+    "@jax.jit\n"
+    "def step(state):\n"
+    "    if state > 0:\n        return state\n"
+    "    return -state\n"
+)
+
+
+def test_jax101_true_positive_decorator():
+    assert rules_of(JIT_IF, replay_critical=False) == ["JAX101"]
+
+
+def test_jax101_true_positive_lax_body():
+    src = (
+        "import jax\n\n"
+        "def outer(st0):\n"
+        "    def body(st):\n"
+        "        while st < 4:\n            st = st + 1\n"
+        "        return st\n"
+        "    return jax.lax.while_loop(lambda s: s < 10, body, st0)\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX101"]
+
+
+def test_jax101_true_negative_static_argnames():
+    src = (
+        "import jax\n\n"
+        "def choose(state, quorum):\n"
+        "    if quorum > 1:\n        return state\n"
+        "    return -state\n\n"
+        "choose_jit = jax.jit(choose, static_argnames=('quorum',))\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_jax101_true_negative_shape_and_none_tests():
+    src = (
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x, y=None):\n"
+        "    if x.ndim > 1 and y is None:\n        return x.sum()\n"
+        "    return x\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+# ---------------- JAX102: mutable capture ----------------
+
+def test_jax102_true_positive_module_mutable():
+    src = (
+        "import jax\n\n"
+        "SCALE = [2.0]\n\n"
+        "@jax.jit\n"
+        "def f(x):\n    return x * SCALE[0]\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX102"]
+
+
+def test_jax102_true_positive_global_stmt():
+    src = (
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    global counter\n"
+        "    return x\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX102"]
+
+
+def test_jax101_nested_helper_inside_jit_is_traced():
+    # factoring the branch into a nested helper must not hide it
+    src = (
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    def inner(y):\n"
+        "        if y > 0:\n            return y\n"
+        "        return -y\n"
+        "    return inner(x)\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX101"]
+
+
+def test_jax102_true_negative_immutable_capture():
+    src = (
+        "import jax\n\n"
+        "SCALES = (2.0, 3.0)\nNAMES = ['a']\n\n"
+        "@jax.jit\n"
+        "def f(x):\n    return x * SCALES[0]\n\n"
+        "def host():\n    return NAMES[0]\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+# ---------------- JAX103: host sync in loop ----------------
+
+def test_jax103_true_positive():
+    src = (
+        "import numpy as np\n\n"
+        "def drive(sim):\n"
+        "    for _ in range(100):\n"
+        "        sim.state = sim.step()\n"
+        "        if np.asarray(sim.state.done):\n            break\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX103"]
+
+
+def test_jax103_true_positive_item():
+    src = (
+        "def drive(steps, st):\n"
+        "    while st.t.item() < 10:\n        st = step(st)\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX103"]
+
+
+def test_jax103_true_negative_hoisted_and_host_lists():
+    # sync after the loop + np.asarray on plain host data: both fine
+    src = (
+        "import numpy as np\n\n"
+        "def drive(sim, workload):\n"
+        "    for w in workload:\n"
+        "        sim.push(np.asarray(w, np.int32))\n"
+        "    return np.asarray(sim.state.done)\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_jax103_loop_else_runs_once():
+    src = (
+        "def drive(sim):\n"
+        "    for _ in range(100):\n"
+        "        sim.push()\n"
+        "    else:\n"
+        "        final = sim.state.x.item()\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_jax103_for_iter_evaluates_once():
+    src = (
+        "import numpy as np\n\n"
+        "def scan(st):\n"
+        "    for v in np.asarray(st.own_assign):\n"
+        "        use(v)\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+# ---------------- JAX104: missing static_argnames ----------------
+
+def test_jax104_true_positive():
+    src = (
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\n"
+        "def init(n):\n    return jnp.zeros(n)\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["JAX104"]
+
+
+def test_jax104_true_negative_with_static():
+    src = (
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "def init(n):\n    return jnp.zeros(n)\n\n"
+        "init_jit = jax.jit(init, static_argnames=('n',))\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_jax101_static_declaration_survives_double_marking():
+    # a function can be both a lax body and a named jit target: the
+    # static_argnames declaration must win regardless of which
+    # marking is encountered first
+    src = (
+        "import jax\n\n"
+        "def step(st, n):\n"
+        "    if n > 0:\n        return st\n"
+        "    return -st\n\n"
+        "step_jit = jax.jit(step, static_argnames=('n',))\n"
+        "def outer(st0):\n"
+        "    return jax.lax.while_loop(lambda s: s[1] < 3, step, st0)\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_jax104_lax_bodies_exempt():
+    # lax bodies can't take static_argnames; range over a traced
+    # carry is JAX101's business, not JAX104's
+    src = (
+        "import jax\n\n"
+        "def outer(st0):\n"
+        "    return jax.lax.while_loop(lambda s: s < 10,\n"
+        "                              lambda s: s + 1, st0)\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+# ---------------- pragmas ----------------
+
+def test_pragma_same_line():
+    src = (
+        "import time\n\n"
+        "def stamp():\n"
+        "    return time.time()  # paxlint: allow[DET001] zeroed later\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_pragma_standalone_line_above():
+    src = (
+        "import time\n\n"
+        "def stamp():\n"
+        "    # paxlint: allow[DET001] zeroed in deterministic mode\n"
+        "    return time.time()\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = (
+        "import time\n\n"
+        "def stamp():\n"
+        "    return time.time()  # paxlint: allow[DET002]\n"
+    )
+    assert rules_of(src) == ["DET001"]
+
+
+def test_pragma_star_suppresses_all():
+    src = (
+        "import time\n\n"
+        "def stamp():\n"
+        "    return time.time()  # paxlint: allow[*] legacy\n"
+    )
+    assert rules_of(src) == []
+
+
+# ---------------- baseline mechanics ----------------
+
+def test_baseline_consumes_findings():
+    f = lint.Finding("DET001", "a.py", 3, 0, "m", "h")
+    remaining, stale = lint.apply_baseline(
+        [f, f], {("DET001", "a.py"): 2}
+    )
+    assert remaining == [] and stale == []
+
+
+def test_baseline_stale_entry_reported():
+    remaining, stale = lint.apply_baseline([], {("DET001", "a.py"): 2})
+    assert remaining == []
+    assert stale == [{"rule": "DET001", "file": "a.py", "unused": 2}]
+
+
+def test_baseline_undercount_leaves_findings():
+    f = lint.Finding("DET001", "a.py", 3, 0, "m", "h")
+    remaining, stale = lint.apply_baseline(
+        [f, f], {("DET001", "a.py"): 1}
+    )
+    assert len(remaining) == 1 and stale == []
+
+
+def test_path_scoped_run_skips_out_of_selection_baseline(tmp_path):
+    """A baseline entry for a file OUTSIDE the linted selection is not
+    stale — it never had the chance to match (regression: `python -m
+    tpu_paxos lint tpu_paxos/core` used to fail on the engine.py
+    baseline entry)."""
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"rule": "JAX103", "file": "elsewhere.py", "count": 1}],
+    }))
+    report = lint.run_lint(
+        root=str(tmp_path), paths=["clean.py"], baseline_path=str(bl)
+    )
+    assert report["ok"], report
+    assert report["stale_baseline"] == []
+    # ... but a full (unscoped) run of the same root does report it
+    full = lint.run_lint(root=str(tmp_path), baseline_path=str(bl))
+    assert not full["ok"] and full["stale_baseline"]
+
+
+def test_repo_path_scoped_lint_is_clean():
+    report = lint.run_lint(root=REPO, paths=["tpu_paxos/core"])
+    assert report["ok"], json.dumps(report, indent=1)
+
+
+def test_overlapping_paths_lint_each_file_once():
+    # dir + file inside it: no double-counted findings past baseline
+    report = lint.run_lint(
+        root=REPO, paths=["tpu_paxos", "tpu_paxos/membership/engine.py"]
+    )
+    assert report["ok"], json.dumps(report, indent=1)
+
+
+def test_missing_path_fails_loudly(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        lint.run_lint(root=str(tmp_path), paths=["no_such_file.py"])
+
+
+# ---------------- the repo ships clean, baseline exact ----------------
+
+@pytest.mark.parametrize("use_baseline", [True, False])
+def test_repo_lint_contract(use_baseline):
+    """The committed tree has zero unsuppressed findings and the
+    committed baseline is EXACT: every entry corresponds 1:1 to a
+    live finding (no stale debt), proven by comparing the baselined
+    count against a baseline-free run."""
+    with_bl = lint.run_lint(root=REPO)
+    assert with_bl["ok"], json.dumps(with_bl, indent=1)
+    assert with_bl["findings"] == []
+    assert with_bl["stale_baseline"] == []
+    if use_baseline:
+        return
+    without = lint.run_lint(root=REPO, baseline_path=None)
+    # exactly the baselined findings reappear without the baseline
+    assert len(without["findings"]) == with_bl["baselined"]
+    committed = lint.load_baseline(lint.DEFAULT_BASELINE)
+    got: dict = {}
+    for f in without["findings"]:
+        got[(f["rule"], f["file"])] = got.get((f["rule"], f["file"]), 0) + 1
+    assert got == committed
+
+
+def test_replay_closure_includes_log_via_package_init():
+    """Regression for the reachability analysis: core/sim.py imports
+    tpu_paxos.utils.prng, which executes utils/__init__.py, which
+    imports utils.log — so the logger IS replay-critical even though
+    no replay module names it directly."""
+    files = lint.walk_files(REPO)
+    closure = lint.replay_closure(files, REPO)
+    assert "tpu_paxos.utils.log" in closure
+    assert "tpu_paxos.core.sim" in closure
+    # the analysis package itself is not replay-critical
+    assert "tpu_paxos.analysis.lint" not in closure
+
+
+def test_every_rule_documented():
+    assert set(lint.RULES) == {
+        "DET001", "DET002", "DET003", "DET004",
+        "JAX101", "JAX102", "JAX103", "JAX104",
+    }

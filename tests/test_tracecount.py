@@ -1,0 +1,170 @@
+"""Compile-census guard (analysis/tracecount.py): the counter sees
+real XLA compilations exactly once per distinct program, budget
+arithmetic flags the right module, and enforcement only arms for
+census-comparable (full tier-1-shaped) runs."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_paxos.analysis import tracecount
+
+
+def test_census_counts_fresh_compile_once(compile_census):
+    """A distinct program compiles once; a cache hit adds zero.  The
+    session census (conftest fixture) and this scoped one both see it
+    — listeners stack."""
+    local = tracecount.CompileCensus().start()
+    local.set_label("probe")
+
+    @jax.jit
+    def probe(x):
+        return (x * 3.25 + 17.5).sum() - 0.125
+
+    x = jnp.full((13, 9), 2.0)
+    before = local.counts.get("probe", 0)
+    probe(x).block_until_ready()
+    after_first = local.counts.get("probe", 0)
+    probe(x).block_until_ready()
+    after_second = local.counts.get("probe", 0)
+    local.stop()
+    assert after_first == before + 1
+    assert after_second == after_first  # cached: no recompile
+    assert compile_census.total() >= 1  # session census saw it too
+
+
+def test_census_stop_deactivates():
+    local = tracecount.CompileCensus().start()
+    local.set_label("stopped")
+    local.stop()
+
+    @jax.jit
+    def probe2(x):
+        return (x - 5.75).prod()
+
+    probe2(jnp.full((7, 3), 1.5)).block_until_ready()
+    assert local.counts.get("stopped", 0) == 0
+
+
+def test_budget_violation_names_culprit():
+    c = tracecount.CompileCensus()
+    c.counts = {"tests/test_a.py": 12, "tests/test_b.py": 3,
+                tracecount.STARTUP: 99}
+    budget = {"budgets": {"tests/test_a.py": 10, "tests/test_b.py": 10}}
+    violations = c.check_budget(budget)
+    assert len(violations) == 1
+    assert violations[0].startswith("tests/test_a.py: 12")
+    # startup compiles (collection/imports) are never budgeted
+    assert not any(tracecount.STARTUP in v for v in violations)
+
+
+def test_budget_default_cap_for_unknown_modules():
+    c = tracecount.CompileCensus()
+    c.counts = {"tests/test_new.py": 50}
+    assert c.check_budget({"budgets": {}, "default_budget": 40})
+    assert not c.check_budget({"budgets": {}, "default_budget": 60})
+    assert not c.check_budget({"budgets": {}})  # no default: unjudged
+
+
+def test_should_enforce_requires_full_visit(monkeypatch):
+    monkeypatch.delenv("TPU_PAXOS_COMPILE_CENSUS", raising=False)
+    c = tracecount.CompileCensus()
+    budget = {"budgets": {"tests/test_a.py": 5, "tests/test_b.py": 5}}
+    c.visited = {"tests/test_a.py"}
+    assert not c.should_enforce(budget)  # partial run: not comparable
+    c.visited = {"tests/test_a.py", "tests/test_b.py", "tests/extra.py"}
+    assert c.should_enforce(budget)
+    monkeypatch.setenv("TPU_PAXOS_COMPILE_CENSUS", "0")
+    assert not c.should_enforce(budget)  # kill switch
+    monkeypatch.setenv("TPU_PAXOS_COMPILE_CENSUS", "1")
+    c.visited = set()
+    assert c.should_enforce(budget)  # forced
+
+
+def test_pin_roundtrip(tmp_path):
+    path = str(tmp_path / "budget.json")
+    data = tracecount.save_budget(
+        {"tests/test_a.py": 10, tracecount.STARTUP: 7}, path
+    )
+    loaded = tracecount.load_budget(path)
+    assert loaded == data
+    # headroom 0.3 + slack 8 over the measured 10; startup excluded
+    assert loaded["budgets"] == {"tests/test_a.py": 21}
+    assert loaded["event"] == tracecount.COMPILE_EVENT
+
+
+def test_pin_covers_visited_zero_compile_modules(tmp_path):
+    """A module that compiled nothing at pin time still gets a floor
+    cap — otherwise it stays uncapped and a later retrace regression
+    there passes silently."""
+    path = str(tmp_path / "budget.json")
+    data = tracecount.save_budget(
+        {"tests/test_a.py": 10}, path,
+        visited={"tests/test_a.py", "tests/test_quiet.py"},
+    )
+    assert data["budgets"] == {
+        "tests/test_a.py": 21, "tests/test_quiet.py": 8,
+    }
+
+
+@pytest.mark.slow
+def test_enforcement_fails_run_with_named_culprit(tmp_path):
+    """End-to-end: a pytest session whose compile count exceeds the
+    budget exits non-zero and names the culprit module (the CI
+    surface).  Forced via TPU_PAXOS_COMPILE_CENSUS=1 with a
+    deliberately-impossible budget for one tiny module.  Marked slow
+    (spawns a full pytest+jax subprocess); the budget arithmetic and
+    sessionfinish wiring have fast unit coverage above."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    budget_path = tmp_path / "tight.json"
+    budget_path.write_text(json.dumps({
+        "version": 1,
+        "event": tracecount.COMPILE_EVENT,
+        "budgets": {"tests/test_values.py": 0},
+    }))
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_", "TPU_PAXOS_COMPILE"))
+    }
+    import __graft_entry__ as ge
+
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ge.scrub_pythonpath(env.get("PYTHONPATH", ""))
+    )
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "TPU_PAXOS_COMPILE_CENSUS": "1",
+        "TPU_PAXOS_COMPILE_BUDGET": str(budget_path),
+    })
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_values.py", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=420, cwd=repo, env=env,
+    )
+    assert p.returncode != 0, p.stdout[-2000:]
+    assert "compile-census budget EXCEEDED" in p.stdout
+    assert "tests/test_values.py" in p.stdout  # the named culprit
+
+
+def test_committed_budget_matches_tier1_suite():
+    """The pinned budget file names real tier-1 test modules (a
+    renamed/deleted module must be re-pinned, not left stale)."""
+    import os
+
+    import pytest
+
+    if os.environ.get("TPU_PAXOS_COMPILE_CENSUS_PIN"):
+        pytest.skip("pinning run: the budget file is being regenerated")
+    budget = tracecount.load_budget()
+    assert budget, "tpu_paxos/analysis/compile_budget.json missing"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for label in budget["budgets"]:
+        assert os.path.exists(os.path.join(repo, label)), (
+            f"stale compile budget entry {label}: module no longer "
+            "exists — re-pin via TPU_PAXOS_COMPILE_CENSUS_PIN"
+        )

@@ -25,6 +25,9 @@ Layer map (mirrors SURVEY.md §1 for the reference):
 - scale-out:       ``parallel/`` (mesh, shard_map round loops)
 - membership:      ``membership/`` (member/ parity: per-node role
   views, version-gated quorums, live reconfiguration)
+- meta:            ``analysis/`` (paxlint static analysis of the
+  determinism/jit-hygiene contract, repro-artifact schema, and the
+  compile-census regression guard — pure AST, imports without jax)
 """
 
 from tpu_paxos.config import (

@@ -104,11 +104,16 @@ def _select_backend(backend: str, mesh: int = 0) -> None:
     import jax
 
     try:
+        # platform/provisioning flags select WHERE the program runs,
+        # not what it computes — value-affecting flags (threefry etc.)
+        # live in utils/prng.py (the determinism contract's home)
+        # paxlint: allow[DET004] platform selection, value-neutral
         jax.config.update("jax_platforms", backend)
         if backend == "cpu" and mesh > 1:
             # provision enough virtual CPU devices for the requested
             # mesh (a dev box has one CPU device by default)
             try:
+                # paxlint: allow[DET004] device provisioning, value-neutral
                 jax.config.update("jax_num_cpu_devices", mesh)
             except AttributeError:  # pre-0.5 jax: use the XLA flag
                 os.environ["XLA_FLAGS"] = (
@@ -297,7 +302,9 @@ def _run_member_body(args) -> int:
         # replay pass: the engine re-derives everything from the
         # recorded (seed, geometry, schedule) — positional geometry,
         # --seed and --crash-rate on THIS command line are ignored in
-        # favor of the log's own parameters
+        # favor of the log's own parameters; log stamps go
+        # deterministic so byte-compares of the output are stable
+        os.environ.setdefault("TPU_PAXOS_DETERMINISTIC", "1")
         logger.info(
             "replaying %s: geometry/seed/crash-rate come from the log",
             args.replay_injections,
@@ -443,12 +450,15 @@ def _maybe_save_result(args, res, logger) -> None:
 
 
 def _emit(args, summary: dict) -> None:
+    # both shapes leave the process and get scraped/diffed by harness
+    # scripts — key order must not depend on which code path built the
+    # summary dict (DET003)
     if args.json:
-        print(json.dumps(summary))
+        print(json.dumps(summary, sort_keys=True))
     else:
         status = "ALL INVARIANTS GREEN" if summary.get("ok") else "FAILED"
         detail = ", ".join(
-            f"{k}={v}" for k, v in summary.items() if k not in ("ok",)
+            f"{k}={v}" for k, v in sorted(summary.items()) if k != "ok"
         )
         print(f"[{summary.get('engine')}] {status} ({detail})")
 
@@ -469,12 +479,29 @@ def run_repro(argv) -> int:
                     help="emit a JSON summary instead of the verdict line")
     ap.add_argument("--log-level", type=str, default="INFO")
     args = ap.parse_args(argv)
+    # replay surface: log stamps must not re-introduce wall clock into
+    # anything a byte-compare might capture (utils/log.deterministic_mode)
+    os.environ.setdefault("TPU_PAXOS_DETERMINISTIC", "1")
     _select_backend(args.backend)
     from tpu_paxos.harness import shrink as shr
     from tpu_paxos.utils import log as logm
 
     logger = logm.get_logger("repro", _level(args))
-    rep = shr.reproduce(args.artifact)
+    try:
+        rep = shr.reproduce(args.artifact)
+    except Exception as e:
+        from tpu_paxos.analysis.artifact_schema import ArtifactSchemaError
+
+        if not isinstance(e, ArtifactSchemaError):
+            raise
+        # malformed artifact: fail before the engine does, naming the
+        # offending field (analysis/artifact_schema.py)
+        logger.error("%s", e)
+        _emit(args, {
+            "engine": "repro", "ok": False,
+            "schema_error": {"field": e.field, "problem": e.problem},
+        })
+        return 2
     sys.stdout.write(rep.pop("decision_log"))
     if rep["match"]:
         logger.info(
@@ -498,6 +525,11 @@ def main(argv=None) -> int:
         # subcommand form: the positional grammar below is the
         # reference CLI's (srvcnt cltcnt idcnt); repro takes a path
         return run_repro(argv[1:])
+    if argv and argv[0] == "lint":
+        # static analysis: pure-AST, deliberately runs without jax
+        from tpu_paxos.analysis import lint as lintm
+
+        return lintm.main(argv[1:])
     args = build_parser().parse_args(argv)
     _select_backend(args.backend, args.mesh)
     if args.engine == "sim":

@@ -1,0 +1,48 @@
+"""paxlint — static analysis for the determinism/replay contract.
+
+The package's whole value proposition is *verifiable* behaviour under
+faults: every engine run must be byte-replayable from (seed, config,
+schedule) alone.  That contract has failure modes that are invisible
+at unit-test time and expensive to rediscover one shrink-triage at a
+time (PR 1's ``jax_threefry_partitionable`` incident: a config flag
+silently changed sampled values and broke CLI replay of
+pytest-recorded artifacts).  This subpackage enforces the contract
+*statically*:
+
+- ``lint.py`` — the AST lint engine: file walking, import-graph
+  reachability from the replay-critical roots, pragma suppression
+  (``# paxlint: allow[RULE]``), the committed-baseline mechanism, and
+  the ``python -m tpu_paxos lint`` CLI;
+- ``rules_det.py`` — the DET rule family (wall-clock, unseeded
+  randomness, unordered iteration that escapes the process,
+  ``jax.config.update`` containment);
+- ``rules_jax.py`` — the JAX rule family (traced-value Python
+  branches, mutable closure/global capture in jitted code,
+  host-device syncs in per-round loops, missing-static-args
+  heuristics);
+- ``artifact_schema.py`` — JSON-schema validation for shrink/repro
+  artifacts (applied on ``python -m tpu_paxos repro`` load);
+- ``tracecount.py`` — the compile-census regression guard: counts XLA
+  compilations during the tier-1 suite against the pinned per-module
+  budget in ``compile_budget.json`` (the runtime shadow of the static
+  JAX rules).
+
+Import discipline: everything except ``tracecount`` is pure
+stdlib-AST and MUST import without jax (same lazy discipline as
+``core/__init__.py``) — ``make lint`` runs jax-free in well under
+10 s.  ``tracecount`` only touches jax inside ``CompileCensus.start``.
+"""
+
+_SUBMODULES = (
+    "artifact_schema", "lint", "rules_det", "rules_jax", "tracecount",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"tpu_paxos.analysis.{name}")
+    raise AttributeError(
+        f"module 'tpu_paxos.analysis' has no attribute {name!r}"
+    )

@@ -1,0 +1,248 @@
+"""DET rule family: the determinism/replay contract, statically.
+
+The contract (README "Determinism"): an engine run is a pure function
+of (seed, config, schedule); decision logs, repro artifacts, and
+injection logs byte-compare equal across record and replay.  Anything
+that lets wall-clock time, hash-seed-dependent iteration order, or
+unseeded randomness reach those bytes breaks replay in ways no fixed-
+seed unit test can see.
+
+Rules (scope: the replay-critical import closure, plus — for DET001/
+DET002/DET003 — any *sink function* that itself serializes/writes,
+wherever it lives; see lint.py for both definitions):
+
+- DET001  wall-clock reads (``time.time``/``strftime``/
+          ``perf_counter``/``datetime.now``...).
+- DET002  unseeded randomness (``random.*`` module functions, legacy
+          ``np.random.*`` globals, argless ``default_rng()``,
+          ``os.urandom``, ``uuid.uuid*``, ``secrets.*``).
+- DET003  unordered iteration where order escapes: iterating a
+          set-typed expression unsorted (``for``/comprehension/
+          ``join``/``list``/``tuple``/``*``-unpack), or iterating
+          ``.items()``/``.keys()``/``.values()`` inside a sink
+          function.  ``sorted(...)`` at the iteration site clears it.
+- DET004  ``jax.config.update`` anywhere outside ``utils/prng.py`` —
+          config flags can change sampled values (the PR 1 threefry
+          incident), so the one sanctioned home is the prng module
+          that owns the determinism contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_paxos.analysis import lint
+
+lint.RULES.update({
+    "DET001": "wall-clock read in replay-critical code or a "
+              "serialization sink",
+    "DET002": "unseeded randomness in replay-critical code or a "
+              "serialization sink",
+    "DET003": "unordered set/dict iteration where order escapes the "
+              "process",
+    "DET004": "jax.config.update outside utils/prng.py",
+})
+
+_WALL_CLOCK = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.localtime",
+    "time.gmtime", "time.strftime", "time.ctime", "time.asctime",
+    "datetime.datetime.now", "datetime.now", "datetime.datetime.utcnow",
+    "datetime.utcnow", "datetime.date.today", "date.today",
+    "datetime.today",
+}
+
+_RANDOM_EXACT = {"os.urandom", "uuid.uuid1", "uuid.uuid4"}
+_RANDOM_PREFIXES = ("random.", "np.random.", "numpy.random.", "secrets.")
+
+#: Order-insensitive consumers: a set expression inside these is fine.
+_ORDER_SAFE_CALLS = {
+    "sorted", "len", "min", "max", "sum", "any", "all", "set",
+    "frozenset", "bool",
+}
+
+#: Iteration-forcing calls whose argument order escapes into the
+#: result (and typically onward into output).
+_ITER_CALLS = {"list", "tuple", "enumerate", "iter", "next", "str",
+               "repr", "format"}
+
+_DICT_VIEW_METHODS = {"keys", "values", "items"}
+
+#: jax modules namespace the seeded counter-based PRNG lives in —
+#: never flagged by DET002.
+_SEEDED_PREFIXES = ("jax.random.", "prng.", "jrandom.")
+
+
+def _pragma_hint(rule: str) -> str:
+    return f"or mark intentional: `# paxlint: allow[{rule}] <reason>`"
+
+
+def check_module(ctx: lint.ModuleContext) -> list[lint.Finding]:
+    findings: list[lint.Finding] = []
+    sink_cache: dict[ast.AST, bool] = {}
+
+    def in_scope(node: ast.AST) -> bool:
+        """DET001-003 scope: replay closure, or inside a sink fn."""
+        if ctx.replay_critical:
+            return True
+        fn = lint.enclosing_function(node)
+        if fn is None:
+            return False
+        if fn not in sink_cache:
+            sink_cache[fn] = lint.is_sink_function(fn)
+        return sink_cache[fn]
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = lint.call_name(node)
+            if not name:
+                continue
+            _check_wall_clock(ctx, node, name, in_scope, findings)
+            _check_randomness(ctx, node, name, in_scope, findings)
+            _check_config_update(ctx, node, name, findings)
+        itered = _iterated_exprs(node)
+        for expr in itered:
+            _check_unordered(ctx, node, expr, in_scope, findings)
+    return findings
+
+
+# ---------------- DET001 / DET002 / DET004 ----------------
+
+def _check_wall_clock(ctx, node, name, in_scope, findings) -> None:
+    if name in _WALL_CLOCK and in_scope(node):
+        findings.append(ctx.finding(
+            "DET001", node,
+            f"wall-clock read `{name}()` can reach replayed/serialized "
+            "bytes",
+            "gate it behind utils/log.deterministic_mode() (zeroed "
+            "stamps) or move timing out of the serialization path; "
+            + _pragma_hint("DET001"),
+        ))
+
+
+def _check_randomness(ctx, node, name, in_scope, findings) -> None:
+    if name.startswith(_SEEDED_PREFIXES):
+        return
+    unseeded = (
+        name in _RANDOM_EXACT
+        or (name.startswith(_RANDOM_PREFIXES)
+            # seeded constructions are the sanctioned pattern
+            and not (name.endswith(".default_rng") and node.args))
+    )
+    if unseeded and in_scope(node):
+        findings.append(ctx.finding(
+            "DET002", node,
+            f"unseeded randomness `{name}()` in replay-critical code",
+            "derive randomness from utils/prng streams (pure function "
+            "of seed/tag/round) or seed an explicit Generator; "
+            + _pragma_hint("DET002"),
+        ))
+
+
+def _check_config_update(ctx, node, name, findings) -> None:
+    if name != "jax.config.update":
+        return
+    if ctx.path.replace("\\", "/").endswith("tpu_paxos/utils/prng.py"):
+        return
+    findings.append(ctx.finding(
+        "DET004", node,
+        "jax.config.update outside utils/prng.py — config flags can "
+        "silently change sampled values (the threefry incident)",
+        "move determinism-relevant flags into utils/prng.py; for "
+        "value-neutral platform/provisioning flags, "
+        + _pragma_hint("DET004"),
+    ))
+
+
+# ---------------- DET003 ----------------
+
+def _iterated_exprs(node: ast.AST) -> list[ast.AST]:
+    """Expressions whose iteration order this node consumes."""
+    out: list[ast.AST] = []
+    if isinstance(node, ast.For):
+        out.append(node.iter)
+    elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                           ast.DictComp)):
+        out.extend(gen.iter for gen in node.generators)
+    elif isinstance(node, ast.Starred):
+        out.append(node.value)
+    elif isinstance(node, ast.Call):
+        name = lint.call_name(node)
+        if name in _ITER_CALLS and node.args:
+            out.append(node.args[0])
+        elif name.endswith(".join") and node.args:
+            out.append(node.args[0])
+    return out
+
+
+def _is_set_expr(expr: ast.AST) -> bool:
+    """Syntactic evidence that ``expr`` is a set (hash-ordered)."""
+    if isinstance(expr, ast.Set):
+        return True
+    if isinstance(expr, ast.SetComp):
+        return True
+    if isinstance(expr, ast.Call):
+        name = lint.call_name(expr)
+        if name in ("set", "frozenset"):
+            return True
+        # repo idiom: accessors named *_set() return sets
+        # (MemberSim.crashed_set / acceptor_set / learner_set)
+        if name.rsplit(".", 1)[-1].endswith("_set"):
+            return True
+        if name.rsplit(".", 1)[-1] in (
+            "union", "intersection", "difference", "symmetric_difference"
+        ):
+            return True
+    if isinstance(expr, ast.BinOp) and isinstance(
+        expr.op, (ast.Sub, ast.BitAnd, ast.BitOr, ast.BitXor)
+    ):
+        return _is_set_expr(expr.left) or _is_set_expr(expr.right)
+    return False
+
+
+def _is_dict_view(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr in _DICT_VIEW_METHODS
+        and not expr.args
+    )
+
+
+def _order_consumed_safely(node: ast.AST) -> bool:
+    """Is the *iteration context itself* wrapped in an order-
+    insensitive consumer (``sorted(list(S))``, ``len([... for ...])``,
+    membership tests)?"""
+    parent = getattr(node, "paxlint_parent", None)
+    if isinstance(parent, ast.Call):
+        if lint.call_name(parent) in _ORDER_SAFE_CALLS:
+            return True
+    if isinstance(parent, ast.Compare):
+        return True  # subset/equality tests are order-insensitive
+    return False
+
+
+def _check_unordered(ctx, node, expr, in_scope, findings) -> None:
+    if not in_scope(node):
+        return
+    if _order_consumed_safely(node):
+        return
+    if _is_set_expr(expr):
+        findings.append(ctx.finding(
+            "DET003", expr,
+            "iteration over a set — hash order can escape into "
+            "logs/serialized bytes",
+            "wrap in sorted(...) where the order leaves the process; "
+            + _pragma_hint("DET003"),
+        ))
+    elif _is_dict_view(expr):
+        fn = lint.enclosing_function(node)
+        if fn is not None and lint.is_sink_function(fn):
+            findings.append(ctx.finding(
+                "DET003", expr,
+                "dict-view iteration feeding a serialization sink — "
+                "insertion order escapes the process",
+                "sort the items (sorted(d.items())) or use "
+                "json.dumps(..., sort_keys=True); "
+                + _pragma_hint("DET003"),
+            ))

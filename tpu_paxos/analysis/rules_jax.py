@@ -1,0 +1,443 @@
+"""JAX rule family: jit-hygiene, statically.
+
+These are the compile-time mirrors of the compile-census guard
+(``tracecount.py``): each pattern below either fails at trace time
+with an opaque ``TracerBoolConversionError``, silently bakes stale
+state into a compiled function, or causes retrace storms / per-round
+host-device ping-pong that the census then catches at runtime.
+
+Scope: *traced scopes* — functions decorated with / wrapped in
+``jax.jit`` (including ``functools.partial(jax.jit, ...)``), bodies
+handed to ``jax.lax.scan`` / ``while_loop`` / ``fori_loop`` /
+``cond`` / ``switch`` / ``map``, and any function lexically nested
+inside one.
+
+- JAX101  Python ``if``/``while`` on a traced value: branching on a
+          non-static parameter of a traced scope needs ``lax.cond``/
+          ``lax.select``/``jnp.where`` (or the parameter declared in
+          ``static_argnames``).  Shape/dtype/ndim tests are static
+          and exempt.
+- JAX102  mutable capture: reading a ``global`` or a module-level
+          ``list``/``dict``/``set`` inside a traced scope bakes the
+          value at trace time — mutations after the first call are
+          silently ignored.
+- JAX103  host-device sync inside a host-side loop: ``.item()``,
+          ``.block_until_ready()``, ``np.asarray``/``np.array``/
+          ``jax.device_get`` called once per iteration serializes the
+          device pipeline (the per-round-loop antipattern).
+- JAX104  jit without static args on a function whose parameter
+          shapes Python control flow: a param used in ``range()`` or
+          as an array-constructor shape wants ``static_argnames`` —
+          without it the call fails on tracers or retraces per value.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_paxos.analysis import lint
+
+lint.RULES.update({
+    "JAX101": "Python if/while on a traced value inside jitted/"
+              "scanned code",
+    "JAX102": "mutable global/closure capture inside jitted code",
+    "JAX103": "host-device sync inside a per-round host loop",
+    "JAX104": "jit without static_argnames on a shape-controlling "
+              "parameter",
+})
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+#: lax control-flow: positional index -> which args are traced bodies.
+_LAX_BODY_ARGS = {
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": None, "map": (0,),  # switch: args[1:]
+}
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get", "device_get"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _lax_kind(name: str) -> str | None:
+    """'cond' for jax.lax.cond / lax.cond, etc.; None otherwise."""
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] == "lax" and parts[-1] in _LAX_BODY_ARGS:
+        return parts[-1]
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> tuple[bool, ast.Call | None]:
+    """Is this expression ``jax.jit`` / ``partial(jax.jit, ...)``?
+    Returns (is_jit, the call carrying static-arg kwargs or None)."""
+    if lint.call_name(node) in _JIT_NAMES and not isinstance(node, ast.Call):
+        return True, None
+    if isinstance(node, ast.Call):
+        name = lint.call_name(node)
+        if name in _JIT_NAMES:
+            return True, node
+        if name in _PARTIAL_NAMES and node.args and (
+            lint.call_name(node.args[0]) in _JIT_NAMES
+        ):
+            return True, node
+    return False, None
+
+
+def _static_params(func: ast.FunctionDef, jit_call: ast.Call | None
+                   ) -> set[str]:
+    """Parameter names declared static at the jit site."""
+    if jit_call is None:
+        return set()
+    params = [a.arg for a in func.args.posonlyargs + func.args.args]
+    out: set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for el in _const_strs(kw.value):
+                out.add(el)
+        elif kw.arg == "static_argnums":
+            for idx in _const_ints(kw.value):
+                if 0 <= idx < len(params):
+                    out.add(params[idx])
+    return out
+
+
+def _const_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _const_ints(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _collect_traced(
+    tree: ast.Module,
+) -> tuple[dict[ast.AST, set[str]], set[ast.AST]]:
+    """Traced scopes: FunctionDef/Lambda -> static param names, plus
+    the subset that are *jit sites* (where static_argnames is an
+    available fix — lax bodies are traced but take no static args).
+
+    Passes: (1) decorators; (2) ``jax.jit(f, ...)`` value positions
+    resolved by name, plus direct ``jax.jit(lambda ...)``; (3) lax
+    control-flow body arguments (Name refs to local defs / lambdas)."""
+    defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, []).append(node)
+    traced: dict[ast.AST, set[str]] = {}
+    jit_sites: set[ast.AST] = set()
+
+    def mark(func, static: set[str], jit: bool = False) -> None:
+        if func is None:
+            return
+        # a function can be marked from several sites (lax body AND a
+        # named jit wrap); union the static declarations so a param
+        # declared static anywhere is never a JAX101 false positive
+        traced[func] = traced.get(func, set()) | static
+        if jit:
+            jit_sites.add(func)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                is_jit, call = _is_jit_expr(dec)
+                if is_jit:
+                    mark(node, _static_params(node, call), jit=True)
+        if not isinstance(node, ast.Call):
+            continue
+        name = lint.call_name(node)
+        if name in _JIT_NAMES and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                mark(target, set(), jit=True)
+            elif isinstance(target, ast.Name):
+                for fd in defs_by_name.get(target.id, ()):
+                    mark(fd, _static_params(fd, node), jit=True)
+        kind = _lax_kind(name)
+        if kind is not None:
+            idxs = _LAX_BODY_ARGS[kind]
+            bodies = (
+                node.args[1:] if idxs is None
+                else [node.args[i] for i in idxs if i < len(node.args)]
+            )
+            for b in bodies:
+                if isinstance(b, ast.Lambda):
+                    mark(b, set())
+                elif isinstance(b, ast.Name):
+                    for fd in defs_by_name.get(b.id, ()):
+                        mark(fd, set())
+    # closure pass: a def lexically nested inside a traced scope runs
+    # under the same trace (its own params carry traced values from
+    # the call sites in the jitted body), so JAX101/JAX102 must see it
+    # too — it inherits the enclosing scope's static names
+    frontier = list(traced)
+    while frontier:
+        scope = frontier.pop()
+        for sub in ast.walk(scope):
+            if sub is scope or not isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if sub not in traced:
+                traced[sub] = set(traced[scope])
+                frontier.append(sub)
+    return traced, jit_sites
+
+
+def _params(func: ast.AST) -> list[str]:
+    args = func.args
+    return [a.arg for a in args.posonlyargs + args.args
+            + ([args.vararg] if args.vararg else [])
+            + args.kwonlyargs
+            + ([args.kwarg] if args.kwarg else [])]
+
+
+def _traced_scope_of(node: ast.AST, traced: dict[ast.AST, set[str]]):
+    """Innermost traced scope containing ``node`` (lexical nesting in
+    a traced function keeps tracing), or None for host code."""
+    cur = getattr(node, "paxlint_parent", None)
+    while cur is not None:
+        if cur in traced:
+            return cur
+        cur = getattr(cur, "paxlint_parent", None)
+    return None
+
+
+def _mutable_globals(tree: ast.Module) -> set[str]:
+    """Module-level names bound to mutable literals/constructors."""
+    out: set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)) or (
+            isinstance(value, ast.Call)
+            and lint.call_name(value) in ("list", "dict", "set",
+                                          "bytearray", "defaultdict",
+                                          "collections.defaultdict")
+        )
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def check_module(ctx: lint.ModuleContext) -> list[lint.Finding]:
+    findings: list[lint.Finding] = []
+    traced, jit_sites = _collect_traced(ctx.tree)
+    mut_globals = _mutable_globals(ctx.tree)
+    for scope, static in traced.items():
+        _check_traced_branching(ctx, scope, static, traced, findings)
+        _check_mutable_capture(ctx, scope, mut_globals, findings)
+    _check_host_sync_loops(ctx, traced, findings)
+    _check_missing_static(ctx, traced, jit_sites, findings)
+    return findings
+
+
+# ---------------- JAX101 ----------------
+
+def _static_test(test: ast.AST, param_names: set[str]) -> set[str]:
+    """Traced params referenced by ``test`` in a *value* position
+    (shape/dtype/ndim/size attribute reads and len()/isinstance()
+    arguments are static and excluded)."""
+    hot: set[str] = set()
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in param_names):
+            continue
+        parent = getattr(node, "paxlint_parent", None)
+        if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(parent, ast.Call) and lint.call_name(parent) in (
+            "len", "isinstance", "type", "callable", "hasattr"
+        ):
+            continue
+        if _is_none_check(parent, node):
+            continue  # `x is None` specializes on presence: static
+        hot.add(node.id)
+    return hot
+
+
+def _is_none_check(parent: ast.AST, node: ast.Name) -> bool:
+    """``x is None`` / ``x is not None`` — a trace-time presence test
+    on an optional argument, not a branch on traced data."""
+    if not isinstance(parent, ast.Compare):
+        return False
+    if not all(isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+        return False
+    others = [parent.left] + list(parent.comparators)
+    return all(
+        o is node
+        or (isinstance(o, ast.Constant) and o.value is None)
+        for o in others
+    )
+
+
+def _check_traced_branching(ctx, scope, static, traced, findings) -> None:
+    params = set(_params(scope)) - static
+    if not params:
+        return
+    for node in lint._walk_scope(scope):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        hot = _static_test(node.test, params)
+        if hot:
+            kw = "while" if isinstance(node, ast.While) else "if"
+            findings.append(ctx.finding(
+                "JAX101", node,
+                f"Python `{kw}` on traced value(s) "
+                f"{sorted(hot)} inside a jitted/scanned function — "
+                "fails at trace time or silently specializes",
+                "use jax.lax.cond/select/jnp.where, or declare the "
+                "parameter in static_argnames; `# paxlint: "
+                "allow[JAX101] <reason>` if provably static",
+            ))
+
+
+# ---------------- JAX102 ----------------
+
+def _check_mutable_capture(ctx, scope, mut_globals, findings) -> None:
+    # pre-collect locally-bound names: a local shadowing a module-level
+    # mutable is not a capture, regardless of statement order
+    local_names = set(_params(scope))
+    globals_declared: set[str] = set()
+    for node in lint._walk_scope(scope):
+        if isinstance(node, ast.Global):
+            globals_declared.update(node.names)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            local_names.add(node.id)
+    local_names -= globals_declared
+    for node in lint._walk_scope(scope):
+        if isinstance(node, ast.Global):
+            findings.append(ctx.finding(
+                "JAX102", node,
+                f"`global {', '.join(node.names)}` inside a jitted "
+                "function — the value is baked in at trace time",
+                "thread the value through function arguments (retraced "
+                "on change) or close over an immutable",
+            ))
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in mut_globals
+            and node.id not in local_names
+        ):
+            findings.append(ctx.finding(
+                "JAX102", node,
+                f"jitted code reads module-level mutable `{node.id}` — "
+                "mutations after the first call are invisible to the "
+                "compiled function",
+                "pass it as an argument, or bind an immutable "
+                "(tuple/frozenset) snapshot",
+            ))
+
+
+# ---------------- JAX103 ----------------
+
+def _attr_rooted(expr: ast.AST) -> bool:
+    """Does ``expr`` peel (through subscripts/slices) to an attribute
+    chain?  Device state hangs off objects (``st.chosen_vid``,
+    ``self.state.crashed``); plain local names are usually host data,
+    so ``np.asarray(local_list)`` stays unflagged."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return isinstance(expr, ast.Attribute)
+
+
+def _check_host_sync_loops(ctx, traced, findings) -> None:
+    flagged: set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        if _traced_scope_of(node, traced) is not None:
+            continue  # in jitted code these are trace-time no-ops
+        # only code that runs once PER ITERATION: the body (+ the
+        # while test); a For's iterable evaluates once on entry and
+        # an else: block runs once after exit
+        per_iter = node.body + (
+            [node.test] if isinstance(node, ast.While) else []
+        )
+        for sub in (s for stmt in per_iter for s in ast.walk(stmt)):
+            if not isinstance(sub, ast.Call) or sub in flagged:
+                continue
+            # don't descend into nested defs: they execute elsewhere
+            fn = lint.enclosing_function(sub)
+            loop_fn = lint.enclosing_function(node)
+            if fn is not loop_fn:
+                continue
+            name = lint.call_name(sub)
+            attr = name.rsplit(".", 1)[-1] if "." in name else ""
+            sync = attr in _SYNC_ATTRS or (
+                name in _SYNC_CALLS
+                and sub.args and _attr_rooted(sub.args[0])
+            )
+            if sync:
+                flagged.add(sub)
+                findings.append(ctx.finding(
+                    "JAX103", sub,
+                    f"host-device sync `{name}()` inside a host-side "
+                    "loop — serializes the device pipeline every "
+                    "iteration",
+                    "hoist the transfer out of the loop, batch rounds "
+                    "on device (lax.while_loop), or `# paxlint: "
+                    "allow[JAX103] <reason>` for host-driven engines",
+                ))
+
+
+# ---------------- JAX104 ----------------
+
+def _shapeish_params(func: ast.FunctionDef) -> set[str]:
+    """Params used where only a static Python int works: range()
+    bounds or array-constructor shape arguments."""
+    names = set(_params(func))
+    out: set[str] = set()
+    for node in lint._walk_scope(func):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = lint.call_name(node)
+        is_range = cname == "range"
+        is_ctor = cname.rsplit(".", 1)[-1] in (
+            "zeros", "ones", "full", "empty", "arange", "eye",
+        ) and cname.split(".", 1)[0] in ("jnp", "jax", "np", "numpy")
+        if not (is_range or is_ctor):
+            continue
+        check_args = node.args if is_range else node.args[:1]
+        for a in check_args:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name) and n.id in names:
+                    out.add(n.id)
+    return out
+
+
+def _check_missing_static(ctx, traced, jit_sites, findings) -> None:
+    for scope in jit_sites:
+        static = traced.get(scope, set())
+        if static or not isinstance(scope, ast.FunctionDef):
+            continue
+        shapeish = _shapeish_params(scope) - static
+        if shapeish:
+            findings.append(ctx.finding(
+                "JAX104", scope,
+                f"jitted `{scope.name}` uses parameter(s) "
+                f"{sorted(shapeish)} as range/shape bounds but the "
+                "jit has no static_argnames — calls fail on tracers "
+                "or retrace per value",
+                f"jit with static_argnames={tuple(sorted(shapeish))!r} "
+                "(and watch the compile census for retrace storms)",
+            ))

@@ -1,0 +1,173 @@
+"""Compile-census regression guard: count XLA compilations per test
+module against a pinned budget.
+
+The static JAX rules (rules_jax.py) catch retrace *patterns*; this is
+their runtime shadow: every actual XLA compilation during the tier-1
+suite is counted via ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` event and attributed
+to the test module that triggered it.  An accidental retrace storm —
+a jit cache key that started varying per round, a shape that stopped
+being static — shows up as a module blowing its pinned budget, and CI
+fails naming the culprit module instead of just getting slower.
+
+Budgets live in ``compile_budget.json`` next to this file, measured
+from a full tier-1 run and pinned with headroom (compilation counts
+are deterministic for a fixed suite order — pytest's default
+collection order is deterministic, no ordering plugin is installed,
+and the tier-1 driver additionally passes ``-p no:randomly``; if a
+test-ordering plugin is ever adopted, re-pin and disable it for
+census runs).  Budgets are per test module
+because in-process jit caches are shared: a module's count depends on
+what compiled before it, so they are only comparable for full-suite
+runs.  Enforcement therefore triggers only when every budgeted module
+was visited (or when forced via ``TPU_PAXOS_COMPILE_CENSUS=1``);
+``TPU_PAXOS_COMPILE_CENSUS=0`` disables the guard entirely.
+
+Wiring (tests/conftest.py): a session-long ``CompileCensus`` is
+started at collection time, ``pytest_runtest_setup`` labels counts
+with the running test's module, and ``pytest_sessionfinish`` enforces
+the budget, failing the run with a named culprit.  The ``compile_census``
+fixture exposes the active census to tests.
+
+Import discipline: this module only imports jax inside
+``CompileCensus.start`` — ``tpu_paxos.analysis`` stays importable
+without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: The jax.monitoring event recorded once per backend (XLA) compile.
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+DEFAULT_BUDGET = os.path.join(
+    os.path.dirname(__file__), "compile_budget.json"
+)
+
+#: Label for compilations outside any test (collection, conftest
+#: imports, fixtures of the first test's module setup).  Unbudgeted.
+STARTUP = "<startup>"
+
+
+class CompileCensus:
+    """Counts XLA compilations, attributed to a caller-set label.
+
+    jax.monitoring has no listener-removal API (0.4.x), so ``stop()``
+    deactivates the callback instead of unregistering it; a census
+    object registers at most once."""
+
+    def __init__(self):
+        self.counts: dict[str, int] = {}
+        self.visited: set[str] = set()  # labels seen, even with 0 compiles
+        self._label = STARTUP
+        self._active = False
+        self._registered = False
+
+    # -- counting --
+    def _on_event(self, event: str, duration: float = 0.0, **kw) -> None:
+        if self._active and event == COMPILE_EVENT:
+            self.counts[self._label] = self.counts.get(self._label, 0) + 1
+
+    def start(self) -> "CompileCensus":
+        if not self._registered:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                self._on_event
+            )
+            self._registered = True
+        self._active = True
+        return self
+
+    def stop(self) -> None:
+        self._active = False
+
+    def set_label(self, label: str) -> None:
+        self._label = label
+        self.visited.add(label)
+
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    # -- budget --
+    def check_budget(self, budget: dict) -> list[str]:
+        """Violation strings (empty = within budget).  Only labels
+        present in the budget are judged; unknown labels fall under
+        ``default_budget`` when set."""
+        budgets: dict[str, int] = budget.get("budgets", {})
+        default = budget.get("default_budget")
+        out = []
+        for label in sorted(set(self.counts) | set(budgets)):
+            if label == STARTUP:
+                continue
+            n = self.counts.get(label, 0)
+            cap = budgets.get(label, default)
+            if cap is not None and n > cap:
+                out.append(
+                    f"{label}: {n} XLA compilations > budget {cap} — "
+                    "retrace regression? (see analysis/rules_jax.py "
+                    "JAX101/JAX104 for the usual causes; re-pin "
+                    "compile_budget.json only for intentional changes)"
+                )
+        return out
+
+    def should_enforce(self, budget: dict) -> bool:
+        """Budgets compare like-for-like only when the whole budgeted
+        suite ran in this process (shared jit caches; see module doc)."""
+        forced = os.environ.get("TPU_PAXOS_COMPILE_CENSUS", "")
+        if forced == "0":
+            return False
+        if forced == "1":
+            return True
+        budgets = budget.get("budgets", {})
+        return bool(budgets) and set(budgets) <= self.visited
+
+    def report(self) -> str:
+        lines = ["compile census (XLA compilations per test module):"]
+        lines.extend(
+            f"  {label:<40s} {n:>4d}"
+            for label, n in sorted(self.counts.items())
+        )
+        lines.append(f"  {'total':<40s} {self.total():>4d}")
+        return "\n".join(lines)
+
+
+def load_budget(path: str = DEFAULT_BUDGET) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_budget(
+    counts: dict[str, int], path: str, headroom: float = 0.3,
+    slack: int = 8, visited: set[str] | None = None,
+) -> dict:
+    """Pin a measured census as the new budget: per-module cap =
+    ceil(count * (1 + headroom)) + slack.  The slack floor absorbs
+    single-compile jitter in tiny modules; the proportional part
+    scales with module size.  ``visited`` modules with zero compiles
+    are pinned at the floor too — otherwise a module that compiled
+    nothing at pin time stays uncapped forever and a later retrace
+    regression there passes silently."""
+    labels = set(counts) | set(visited or ())
+    budgets = {
+        label: int(counts.get(label, 0) * (1 + headroom)) + slack
+        for label in sorted(labels)
+        if label != STARTUP
+    }
+    data = {
+        "version": 1,
+        "event": COMPILE_EVENT,
+        "headroom": headroom,
+        "slack": slack,
+        "budgets": budgets,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data

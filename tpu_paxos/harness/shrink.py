@@ -36,13 +36,16 @@ import os
 
 import numpy as np
 
+from tpu_paxos.analysis.artifact_schema import (
+    ARTIFACT_FORMAT,
+    ArtifactSchemaError,
+    validate_artifact,
+)
 from tpu_paxos.config import FaultConfig, ProtocolConfig, SimConfig
 from tpu_paxos.core import faults as fltm
 from tpu_paxos.core import sim as simm
 from tpu_paxos.harness import validate
 from tpu_paxos.replay.decision_log import decision_log
-
-ARTIFACT_FORMAT = "tpu-paxos-repro-1"
 
 # Cap on shrink re-runs: each candidate evaluation is a full engine
 # run (tiny configs, but a compile each when the schedule changes
@@ -354,24 +357,44 @@ def save_artifact(path: str, case: ReproCase, violation: str) -> dict:
 
 
 def load_artifact(path: str) -> tuple[ReproCase, dict]:
-    with open(path) as f:
-        art = json.load(f)
-    if art.get("format") != ARTIFACT_FORMAT:
-        raise ValueError(
-            f"unknown repro-artifact format {art.get('format')!r} "
-            f"(expected {ARTIFACT_FORMAT!r})"
+    # every rejection — unreadable file, truncated JSON, wrong format,
+    # bad field — flows through ArtifactSchemaError so it carries a
+    # field path (when one exists) and reaches the CLI's clean exit-2
+    # surface instead of a raw traceback
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except OSError as e:
+        raise ArtifactSchemaError("", f"unreadable artifact: {e}") from None
+    except json.JSONDecodeError as e:
+        raise ArtifactSchemaError(
+            "", f"invalid JSON (truncated write?): {e}"
+        ) from None
+    try:
+        validate_artifact(art)
+    except ArtifactSchemaError as e:
+        raise ArtifactSchemaError(
+            e.field, f"{e.problem} (artifact {path!r})"
+        ) from None
+    try:
+        case = ReproCase(
+            cfg=_cfg_from_dict(art["cfg"]),
+            workload=[np.asarray(w, np.int32) for w in art["workload"]],
+            gates=(
+                None
+                if art["gates"] is None
+                else [np.asarray(g, np.int32) for g in art["gates"]]
+            ),
+            chains=[np.asarray(c, np.int32) for c in art["chains"]],
+            extra_checks=art.get("extra_checks") or {},
         )
-    case = ReproCase(
-        cfg=_cfg_from_dict(art["cfg"]),
-        workload=[np.asarray(w, np.int32) for w in art["workload"]],
-        gates=(
-            None
-            if art["gates"] is None
-            else [np.asarray(g, np.int32) for g in art["gates"]]
-        ),
-        chains=[np.asarray(c, np.int32) for c in art["chains"]],
-        extra_checks=art.get("extra_checks") or {},
-    )
+    except (ValueError, TypeError) as e:
+        # semantic constraints the config/episode constructors enforce
+        # beyond the schema's type/range checks (empty intervals,
+        # zero retry counts, ...) still get the clean exit-2 surface
+        raise ArtifactSchemaError(
+            "cfg", f"rejected by config validation: {e} (artifact {path!r})"
+        ) from None
     return case, art
 
 

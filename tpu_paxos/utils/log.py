@@ -27,6 +27,33 @@ LEVEL_NAMES = ("TRACE", "DEBUG", "INFO", "NOTICE", "WARNING", "ERROR", "CRITICAL
 
 _lock = threading.Lock()  # stdout serialization (ref Logger's SpinLock)
 
+#: Fixed stamp emitted in deterministic mode: same width/format as a
+#: real one, so line-oriented consumers (and byte-compares) see a
+#: stable prefix instead of wall clock.
+ZERO_STAMP = "0000-00-00 00:00:00.000"
+
+
+def deterministic_mode() -> bool:
+    """True when log output must be byte-stable across runs
+    (``TPU_PAXOS_DETERMINISTIC=1``).  Replay surfaces — ``python -m
+    tpu_paxos repro`` and ``--replay-injections`` — switch this on so
+    nothing a byte-compare might capture carries wall-clock time; the
+    env var is read per call so tests can toggle it."""
+    return os.environ.get("TPU_PAXOS_DETERMINISTIC", "") not in ("", "0")
+
+
+def _stamp() -> str:
+    """Wall-clock line stamp, zeroed under deterministic_mode().  The
+    one sanctioned wall-clock read in the replay-critical import
+    closure: it exists only for humans tailing stderr and is
+    suppressed whenever bytes must replay."""
+    if deterministic_mode():
+        return ZERO_STAMP
+    now = time.time()  # paxlint: allow[DET001] zeroed in deterministic mode
+    # paxlint: allow[DET001] zeroed in deterministic mode
+    base = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
+    return f"{base}.{int((now % 1) * 1000):03d}"
+
 
 def parse_level(raw: str, default: int = INFO) -> int:
     """Numeric level from a name or digit; clamps digits to the valid
@@ -70,14 +97,11 @@ class Logger:
             frame = sys._getframe(depth + 1)
         except ValueError:
             frame = sys._getframe()
-        now = time.time()
-        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
-        ms = int((now % 1) * 1000)
         where = f"{os.path.basename(frame.f_code.co_filename)}:{frame.f_lineno}"
         fn = frame.f_code.co_name
         text = msg % args if args else msg
         line = (
-            f"[{stamp}.{ms:03d}]\t[{LEVEL_NAMES[level]}]\t[{self.name}]\t"
+            f"[{_stamp()}]\t[{LEVEL_NAMES[level]}]\t[{self.name}]\t"
             f"[{where}]\t[{fn}]\t{text}\n"
         )
         with _lock:
