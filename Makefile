@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: test test-slow check lint lint-json bench bench-sharded parity \
-	parity-fast replay-diff replay-diff-member run stress stress-quick \
-	clean
+.PHONY: test test-slow check lint lint-json audit audit-json bench \
+	bench-sharded parity parity-fast replay-diff replay-diff-member \
+	run stress stress-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -25,13 +25,24 @@ lint:
 lint-json:
 	$(PY) -m tpu_paxos lint --json
 
+# jaxpr-audit: trace-time IR contracts (IR201-IR205) + pinned op/cost
+# budget over the registered entry points of both engines and the
+# sharded path (tpu_paxos/analysis/jaxpr_audit.py).  Traces on CPU —
+# ops counts are backend-independent.  Re-pin after intentional
+# program growth: TPU_PAXOS_OP_BUDGET_PIN=1 make audit.
+audit:
+	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit
+
+audit-json:
+	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --json
+
 # Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
 # static analyzers first (cheapest signal), then the fast tier with
 # NaN-checking on, then an un-jitted op-by-op smoke of one tiny config
 # per engine (every cond predicate, slice bound, and dtype
 # materializes eagerly).  The pallas interpreter path is part of the
 # fast tier (tests/test_fastwin.py).
-check: lint
+check: lint audit
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 

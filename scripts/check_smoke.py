@@ -22,6 +22,7 @@ import jax  # noqa: E402
 # initializes the backend first); switch through jax.config like
 # tests/conftest.py.  Op-by-op through a device tunnel would take
 # minutes per round.
+# paxlint: allow[DET004] platform selection, value-neutral
 jax.config.update("jax_platforms", "cpu")
 
 assert jax.config.jax_disable_jit, "run via make check (JAX_DISABLE_JIT=1)"

@@ -24,6 +24,7 @@ import jax  # noqa: E402
 
 # Env-var platform selection is too late (axon sitecustomize); switch
 # through jax.config like tests/conftest.py.
+# paxlint: allow[DET004] platform selection, value-neutral
 jax.config.update("jax_platforms", "cpu")
 
 from tpu_paxos.membership.engine import MemberSim  # noqa: E402

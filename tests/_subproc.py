@@ -1,0 +1,31 @@
+"""Shared subprocess-environment builder for CLI/e2e tests.
+
+Every test that shells out (paxlint CLI, jaxpr-audit CLI, the census
+e2e run) needs the same scrub: drop the host's JAX_/XLA_ selection
+(the subprocess picks its own platform) plus any test-specific knobs,
+and rebuild PYTHONPATH through ``__graft_entry__.scrub_pythonpath``
+so the repo under test wins over any injected site dirs.  One helper,
+three call sites — an env-handling fix lands once.
+"""
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scrubbed_env(extra_prefixes=(), **overrides) -> dict:
+    """Copy of os.environ minus JAX_/XLA_/``extra_prefixes`` keys,
+    with a scrubbed repo-first PYTHONPATH; ``overrides`` are applied
+    last."""
+    drop = ("JAX_", "XLA_") + tuple(extra_prefixes)
+    env = {
+        k: v for k, v in sorted(os.environ.items())
+        if not k.startswith(drop)
+    }
+    import __graft_entry__ as ge
+
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + ge.scrub_pythonpath(env.get("PYTHONPATH", ""))
+    )
+    env.update(overrides)
+    return env

@@ -25,7 +25,9 @@ except AttributeError:
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=8"
     )
-jax.config.update("jax_threefry_partitionable", True)
+# The threefry pin lives in utils/prng (DET004's one sanctioned home
+# for value-affecting flags); importing it applies the pin.
+from tpu_paxos.utils import prng as _prng  # noqa: E402,F401
 
 # ---- compile-census guard (tpu_paxos/analysis/tracecount.py) ----
 # Counts every XLA compilation and attributes it to the test module

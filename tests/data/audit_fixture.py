@@ -1,0 +1,135 @@
+"""Seeded-violation fixtures for the jaxpr audit: one entry per IR
+rule that the audit MUST flag, and a clean twin it must pass.  Loaded
+as an audit provider via ``--providers tests/data/audit_fixture.py``
+(tests/test_jaxpr_audit.py and the golden CLI report).
+
+Each hot fixture hides its violation the way a real regression would:
+the IR202 widening sits behind a helper function (invisible to the
+AST lint — that is the whole point of the trace-time tier), the IR201
+callback inside a scanned body, the IR203 collective behind
+shard_map.
+"""
+
+import numpy as np
+
+from tpu_paxos.analysis.registry import AuditEntry
+
+#: 16 KiB table: over the hot entry's 1 KiB const budget, under the
+#: clean twin's default 64 KiB.
+_TABLE = np.arange(4096, dtype=np.int32)
+
+
+def _widen(x):
+    """The helper hiding an int64 widening (IR202's seeded leak)."""
+    import jax.numpy as jnp
+
+    return x.astype(jnp.int64)
+
+
+def _scan(body_extra):
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fn(xs):
+        def body(c, x):
+            return c + body_extra(x), x
+
+        c, _ = lax.scan(body, jnp.int32(0), xs)
+        return c
+
+    return fn, (jnp.arange(4, dtype=jnp.int32),)
+
+
+def _build_ir201_hot():
+    import jax
+    import jax.numpy as jnp
+
+    def host_echo(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct((), jnp.int32), x
+        )
+
+    return _scan(host_echo)
+
+
+def _build_ir201_clean():
+    return _scan(lambda x: x)
+
+
+def _build_ir202_hot():
+    import jax.numpy as jnp
+
+    def fn(x):
+        return _widen(x) + 1
+
+    return fn, (jnp.arange(4, dtype=jnp.int32),)
+
+
+def _build_ir202_clean():
+    import jax.numpy as jnp
+
+    def fn(x):
+        return x.astype(jnp.int32) + 1
+
+    return fn, (jnp.arange(4, dtype=jnp.int32),)
+
+
+def _build_ir203():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_paxos.parallel import mesh as pmesh
+
+    mesh = pmesh.make_instance_mesh(1)
+
+    def body(x):
+        return x + lax.psum(jnp.sum(x), pmesh.INSTANCE_AXIS)
+
+    fn = pmesh.shard_map(
+        body, mesh, in_specs=(P(pmesh.INSTANCE_AXIS),),
+        out_specs=P(pmesh.INSTANCE_AXIS),
+    )
+    return fn, (jnp.arange(8, dtype=jnp.int32),)
+
+
+def _build_ir204(stable: bool):
+    def build():
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(x):
+            return lax.sort(x, is_stable=stable)
+
+        return fn, (jnp.arange(8, dtype=jnp.int32),)
+
+    return build
+
+
+def _build_ir205():
+    import jax.numpy as jnp
+
+    def fn(x):
+        return x + jnp.asarray(_TABLE)
+
+    return fn, (jnp.zeros((4096,), jnp.int32),)
+
+
+def audit_entries():
+    return [
+        AuditEntry("fixture.ir201_hot", _build_ir201_hot, cost=False),
+        AuditEntry("fixture.ir201_clean", _build_ir201_clean, cost=False),
+        AuditEntry("fixture.ir202_hot", _build_ir202_hot, cost=False,
+                   x64=True),
+        AuditEntry("fixture.ir202_clean", _build_ir202_clean, cost=False),
+        AuditEntry("fixture.ir203_hot", _build_ir203, cost=False,
+                   covers=("_build_ir203",)),
+        AuditEntry("fixture.ir203_clean", _build_ir203, cost=False,
+                   mesh_axes=("i",)),
+        AuditEntry("fixture.ir204_hot", _build_ir204(False), cost=False),
+        AuditEntry("fixture.ir204_clean", _build_ir204(True), cost=False),
+        AuditEntry("fixture.ir205_hot", _build_ir205, cost=False,
+                   const_budget=1024),
+        AuditEntry("fixture.ir205_clean", _build_ir205, cost=False),
+    ]

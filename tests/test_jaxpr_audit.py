@@ -1,0 +1,390 @@
+"""jaxpr-audit: IR rules, registry sweep, op/cost budget, CLI.
+
+Three layers, mirroring tests/test_paxlint*.py:
+
+- **Tier-1 enforcement**: ``test_repo_audit_within_budget`` runs the
+  full audit in-process against the committed ``op_budget.json`` —
+  tightening a pin below the measured count fails THIS test naming
+  the entry point (the acceptance contract).
+- **Fixture layer**: one seeded violation per IR rule
+  (tests/data/audit_fixture.py) that the checker must flag, and a
+  clean twin it must pass.
+- **CLI layer**: golden-JSON report pinned byte-for-byte
+  (tests/data/jaxpr_audit_golden.json) and a budget-breach e2e run
+  asserting exit code, the named entry point, and the triage-dir
+  jaxpr dump.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_paxos.analysis import ir_rules, jaxpr_audit
+from tpu_paxos.analysis import registry as regm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_PROVIDER = os.path.join(REPO, "tests", "data", "audit_fixture.py")
+GOLDEN = os.path.join(REPO, "tests", "data", "jaxpr_audit_golden.json")
+
+
+# ---------------- registry + repo audit ----------------
+
+@pytest.fixture(scope="module")
+def repo_report():
+    """One full audit of the shipped tree, shared by the module."""
+    return jaxpr_audit.run_audit(root=REPO)
+
+
+def test_repo_audit_within_budget(repo_report):
+    # the tier-1 hook: IR findings, sweep problems, and op/cost budget
+    # breaches all land here with the culprit named in the report
+    assert repo_report["ok"], json.dumps(
+        {k: repo_report[k] for k in ("findings", "sweep", "budget")},
+        indent=1, sort_keys=True,
+    )
+
+
+def test_every_provider_registers_entries(repo_report):
+    entries = regm.collect()
+    by_module: dict[str, int] = {}
+    for name in regm.AUDIT_PROVIDERS:
+        mod = regm.provider_module(name)
+        by_module[name] = len(mod.audit_entries())
+    assert all(n >= 1 for n in by_module.values()), by_module
+    # both engines + the sharded path are in the report
+    for expected in ("sim.run_rounds", "member.round",
+                     "sharded.choose_all", "sharded_sim.run_rounds",
+                     "fast.choose_all", "simkern.store_accepts",
+                     "simkern.accum_acks"):
+        assert expected in repo_report["entries"], expected
+    assert len(entries) == len(repo_report["entries"])
+
+
+def test_registry_rejects_duplicate_names(tmp_path):
+    prov = tmp_path / "dup_provider.py"
+    prov.write_text(
+        "from tpu_paxos.analysis.registry import AuditEntry\n"
+        "def audit_entries():\n"
+        "    b = lambda: (lambda x: x, (1,))\n"
+        "    return [AuditEntry('d.same', b), AuditEntry('d.same', b)]\n"
+    )
+    names = jaxpr_audit._load_provider_arg(str(prov))
+    with pytest.raises(regm.RegistryError, match="duplicate"):
+        regm.collect(names)
+
+
+def test_registry_rejects_missing_provider_fn(tmp_path):
+    prov = tmp_path / "empty_provider.py"
+    prov.write_text("x = 1\n")
+    names = jaxpr_audit._load_provider_arg(str(prov))
+    with pytest.raises(regm.RegistryError, match="audit_entries"):
+        regm.collect(names)
+
+
+# ---------------- unregistered-function sweep ----------------
+
+def _sweep_of(tmp_path, source: str, entries_src: str) -> list[dict]:
+    prov = tmp_path / "sweep_provider.py"
+    prov.write_text(
+        "from tpu_paxos.analysis.registry import AuditEntry\n"
+        + source + "\n" + entries_src
+    )
+    names = jaxpr_audit._load_provider_arg(str(prov))
+    return jaxpr_audit.run_sweep(names, root=str(tmp_path))
+
+
+def test_sweep_flags_unregistered_jit_surface(tmp_path):
+    problems = _sweep_of(
+        tmp_path,
+        "import jax\n"
+        "def rogue(x):\n"
+        "    return jax.jit(lambda y: y)(x)\n",
+        "def audit_entries():\n    return []\n",
+    )
+    assert [p["kind"] for p in problems] == ["unregistered_surface"]
+    assert problems[0]["surface"] == "rogue"
+
+
+def test_sweep_accepts_covered_and_exempt(tmp_path):
+    problems = _sweep_of(
+        tmp_path,
+        "import jax\n"
+        "def covered(x):\n"
+        "    def inner(y):\n"
+        "        return jax.jit(lambda z: z)(y)\n"
+        "    return inner(x)\n"
+        "def debug_only(x):\n"
+        "    return jax.jit(lambda z: z)(x)\n"
+        "AUDIT_EXEMPT = {'debug_only': 'debug helper, never in the "
+        "round path'}\n",
+        # prefix cover: "covered" also covers the nested "covered.inner"
+        "def audit_entries():\n"
+        "    return [AuditEntry('s.c', lambda: (lambda x: x, (1,)),"
+        " covers=('covered',))]\n",
+    )
+    assert problems == []
+
+
+def test_sweep_coverage_is_scoped_per_module(tmp_path):
+    """A covers= name in one provider must not silently cover a
+    same-named surface in ANOTHER provider — coverage is per module,
+    or the opt-in guarantee is gone."""
+    a = tmp_path / "prov_a.py"
+    a.write_text(
+        "from tpu_paxos.analysis.registry import AuditEntry\n"
+        "import jax\n"
+        "def shared_name(x):\n"
+        "    return jax.jit(lambda y: y)(x)\n"
+        "def audit_entries():\n"
+        "    return [AuditEntry('a.e', lambda: (lambda x: x, (1,)),"
+        " covers=('shared_name',))]\n"
+    )
+    b = tmp_path / "prov_b.py"
+    b.write_text(
+        "import jax\n"
+        "def shared_name(x):\n"
+        "    return jax.jit(lambda y: y)(x)\n"
+        "def audit_entries():\n"
+        "    return []\n"
+    )
+    names = jaxpr_audit._load_provider_arg(f"{a},{b}")
+    problems = jaxpr_audit.run_sweep(names, root=str(tmp_path))
+    assert [(p["kind"], p["surface"]) for p in problems] == [
+        ("unregistered_surface", "shared_name")
+    ]
+    assert problems[0]["module"].endswith("prov_b")
+
+
+def test_sweep_catches_module_level_jit_assignment(tmp_path):
+    problems = _sweep_of(
+        tmp_path,
+        "import jax\n"
+        "def f(x):\n    return x\n"
+        "f_jit = jax.jit(f)\n",
+        "def audit_entries():\n    return []\n",
+    )
+    assert [p["surface"] for p in problems] == ["f_jit"]
+
+
+def test_sweep_catches_partial_jit_decorator(tmp_path):
+    # the standard static-args idiom must not slip past the sweep
+    problems = _sweep_of(
+        tmp_path,
+        "import functools\nimport jax\n"
+        "@functools.partial(jax.jit, static_argnames=('k',))\n"
+        "def stepper(x, k):\n"
+        "    return x * k\n",
+        "def audit_entries():\n    return []\n",
+    )
+    assert [p["surface"] for p in problems] == ["stepper"]
+
+
+def test_scoped_providers_do_not_report_stale_pins(tmp_path):
+    # auditing a provider subset against the full committed budget:
+    # untraced engine entries are NOT stale (they are still
+    # registered, just out of scope this run)
+    report = jaxpr_audit.run_audit(
+        providers=("tpu_paxos.core.fast",),
+        budget_path=jaxpr_audit.DEFAULT_BUDGET,
+        triage_dir=str(tmp_path), root=REPO,
+    )
+    assert report["budget"]["stale"] == []
+    assert report["budget"]["violations"] == []
+    assert report["ok"], report["budget"]
+
+
+# ---------------- IR rule fixtures (hot + clean twin) ----------------
+
+@pytest.fixture(scope="module")
+def fixture_entries():
+    names = jaxpr_audit._load_provider_arg(FIXTURE_PROVIDER)
+    return {e.name: e for e in regm.collect(names)}
+
+
+def _findings_for(entries, name):
+    entry = entries[name]
+    closed, _fn, _args = jaxpr_audit.trace_entry(entry)
+    return ir_rules.check_entry(entry, closed)
+
+
+@pytest.mark.parametrize("rule", ["ir201", "ir202", "ir203", "ir204",
+                                  "ir205"])
+def test_ir_rule_flags_hot_and_passes_clean(fixture_entries, rule):
+    hot = _findings_for(fixture_entries, f"fixture.{rule}_hot")
+    clean = _findings_for(fixture_entries, f"fixture.{rule}_clean")
+    assert rule.upper() in {f.rule for f in hot}, hot
+    assert clean == [], clean
+
+
+def test_ir202_names_the_primitive_path(fixture_entries):
+    hot = _findings_for(fixture_entries, "fixture.ir202_hot")
+    paths = {f.path for f in hot if f.rule == "IR202"}
+    # the widening is named by its traced primitive, even though the
+    # source hides it behind a helper function
+    assert any(p.endswith("/convert_element_type") for p in paths), paths
+
+
+def test_entry_allow_waives_rule(fixture_entries):
+    import dataclasses
+
+    hot = fixture_entries["fixture.ir204_hot"]
+    waived = dataclasses.replace(
+        hot, allow=("IR204",), why="fixture waiver"
+    )
+    closed, _fn, _args = jaxpr_audit.trace_entry(waived)
+    assert ir_rules.check_entry(waived, closed) == []
+
+
+def test_engine_allow_is_scoped_not_global(repo_report):
+    # sim.run_rounds waives IR204 (unique-key compaction sorts) — the
+    # waiver must not leak: the fixture audit still flags IR204
+    entries = {e.name: e for e in regm.collect()}
+    assert "IR204" in entries["sim.run_rounds"].allow
+    assert entries["sim.run_rounds"].why  # a waiver needs its reason
+    assert "IR204" not in entries["member.round"].allow
+
+
+# ---------------- op/cost budget machinery ----------------
+
+def test_check_budget_names_entry_and_delta():
+    measured = {"sim.run_rounds": {"ops": 120, "flops": 10}}
+    budget = {"backend": "cpu",
+              "entries": {"sim.run_rounds": {"ops": 100, "flops": 50}}}
+    violations, stale = jaxpr_audit.check_budget(
+        measured, budget, backend="cpu"
+    )
+    assert len(violations) == 1 and stale == []
+    v = violations[0]
+    assert v["entry"] == "sim.run_rounds" and v["key"] == "ops"
+    assert v["measured"] == 120 and v["cap"] == 100
+    assert "sim.run_rounds" in v["detail"]
+
+
+def test_check_budget_unpinned_entry_is_a_violation():
+    violations, _ = jaxpr_audit.check_budget(
+        {"new.entry": {"ops": 5}}, {"entries": {}}, backend="cpu"
+    )
+    assert [v["entry"] for v in violations] == ["new.entry"]
+    assert "re-pin" in violations[0]["detail"]
+
+
+def test_check_budget_stale_entry_is_flagged():
+    _, stale = jaxpr_audit.check_budget(
+        {}, {"entries": {"gone.entry": {"ops": 5}}}, backend="cpu"
+    )
+    assert stale == ["gone.entry"]
+
+
+def test_check_budget_cost_keys_need_matching_backend():
+    measured = {"e": {"ops": 10, "flops": 999}}
+    budget = {"backend": "tpu", "entries": {"e": {"ops": 50, "flops": 1}}}
+    # flops cap pinned on tpu is not comparable on cpu: only ops judged
+    violations, _ = jaxpr_audit.check_budget(measured, budget,
+                                             backend="cpu")
+    assert violations == []
+    violations, _ = jaxpr_audit.check_budget(measured, budget,
+                                             backend="tpu")
+    assert [v["key"] for v in violations] == ["flops"]
+
+
+def test_save_budget_headroom_and_roundtrip(tmp_path):
+    path = str(tmp_path / "budget.json")
+    data = jaxpr_audit.save_budget(
+        {"e": {"ops": 100, "flops": 10, "prims": {"add": 3}}}, path,
+        headroom=0.3, slack=8, backend="cpu",
+    )
+    assert data["entries"]["e"] == {"ops": 138, "flops": 21}
+    assert jaxpr_audit.load_budget(path) == data
+
+
+def test_budget_breach_dumps_jaxpr_in_process(tmp_path, repo_report):
+    tight = {
+        "version": 1, "backend": repo_report["backend"],
+        "headroom": 0.3, "slack": 8,
+        "entries": {
+            name: {"ops": (1 if name == "member.round"
+                           else m["ops"] + 100)}
+            for name, m in sorted(repo_report["entries"].items())
+        },
+    }
+    bpath = tmp_path / "tight.json"
+    bpath.write_text(json.dumps(tight))
+    triage = tmp_path / "triage"
+    report = jaxpr_audit.run_audit(
+        budget_path=str(bpath), triage_dir=str(triage), root=REPO
+    )
+    assert not report["ok"]
+    assert [v["entry"] for v in report["budget"]["violations"]] == [
+        "member.round"
+    ]
+    dumps = report["budget"]["dumped"]
+    assert len(dumps) == 1 and os.path.exists(dumps[0])
+    text = open(dumps[0], encoding="utf-8").read()
+    assert "member.round" in text and "lambda" in text
+
+
+# ---------------- CLI (subprocess) ----------------
+
+def _audit(args, cwd=REPO):
+    from _subproc import scrubbed_env
+
+    env = scrubbed_env(
+        extra_prefixes=("TPU_PAXOS_OP_BUDGET",), JAX_PLATFORMS="cpu"
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_paxos", "audit", *args],
+        capture_output=True, text=True, timeout=500, cwd=cwd, env=env,
+    )
+
+
+def test_cli_golden_json():
+    p = _audit(["--json", "--no-budget", "--providers",
+                "tests/data/audit_fixture.py"])
+    assert p.returncode == 1, p.stderr[-2000:]  # seeded findings present
+    got = json.loads(p.stdout)
+    with open(GOLDEN, encoding="utf-8") as fh:
+        want = json.load(fh)
+    assert got == want, (
+        "audit JSON report drifted from tests/data/jaxpr_audit_golden"
+        ".json — if intentional, regenerate: python -m tpu_paxos audit "
+        "--json --no-budget --providers tests/data/audit_fixture.py\n"
+        + json.dumps(got, indent=1, sort_keys=True)
+    )
+
+
+@pytest.mark.slow
+def test_cli_budget_breach_e2e(tmp_path):
+    with open(jaxpr_audit.DEFAULT_BUDGET, encoding="utf-8") as fh:
+        budget = json.load(fh)
+    budget["entries"]["sharded_sim.run_rounds"]["ops"] = 1
+    bpath = tmp_path / "tight.json"
+    bpath.write_text(json.dumps(budget))
+    triage = tmp_path / "triage"
+    p = _audit(["--budget", str(bpath), "--triage-dir", str(triage)])
+    assert p.returncode == 1, p.stdout + p.stderr[-2000:]
+    assert "sharded_sim.run_rounds" in p.stdout  # culprit named
+    assert "re-pin" in p.stdout
+    dump = triage / "jaxpr_sharded_sim_run_rounds.txt"
+    assert dump.exists()
+
+
+def test_cli_list_and_rules():
+    p = _audit(["--list"])
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "sim.run_rounds" in p.stdout
+    assert "mesh_axes=i" in p.stdout
+    p = _audit(["--rules"])
+    assert p.returncode == 0
+    for rid in ("IR201", "IR202", "IR203", "IR204", "IR205"):
+        assert rid in p.stdout
+
+
+@pytest.mark.slow
+def test_cli_repo_audit_exits_zero():
+    p = _audit([])
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    assert "0 findings" in p.stdout
+    assert "0 budget violations" in p.stdout

@@ -190,9 +190,12 @@ def test_stale_version_proposer_blocked_until_catchup():
     # it, and node 1 never reaches prepared (its rewound view's quorum
     # is acceptor 0, which is at version 2 and drops its prepares).
     stale_rounds = 0
+    # paxlint: allow[JAX103] per-round observation IS this test's purpose
     while int(np.asarray(ms.state.version)[1]) < v_cur:
+        # paxlint: allow[JAX103] per-round observation IS this test's purpose
         assert not np.any(np.asarray(ms.state.acc_vid) == 300)
         assert not ms.chosen(300)
+        # paxlint: allow[JAX103] per-round observation IS this test's purpose
         assert not bool(np.asarray(ms.state.prepared)[1])
         ms.run_rounds(1)
         stale_rounds += 1

@@ -110,6 +110,125 @@ def test_det003_true_negative_order_insensitive():
     assert rules_of(src) == []
 
 
+# -- dataflow-aware DET003: set/dict-view kinds through locals --
+
+def test_det003_dataflow_set_through_local():
+    src = (
+        "def emit(out, xs):\n"
+        "    s = set(xs)\n"
+        "    for x in s:\n"
+        "        out.write(str(x))\n"
+    )
+    findings = lint.lint_source(src)
+    assert [f.rule for f in findings] == ["DET003"]
+    assert "set-typed by assignment" in findings[0].message
+
+
+def test_det003_dataflow_chained_local():
+    # one hop of name-to-name propagation: t = s = set(...)-ish chains
+    src = (
+        "def emit(out, xs):\n"
+        "    s = set(xs)\n"
+        "    t = s\n"
+        "    for x in t:\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == ["DET003"]
+
+
+def test_det003_dataflow_true_negative_sorted_assignment():
+    # the local holds a LIST (sorted) — iteration is deterministic
+    src = (
+        "def emit(out, xs):\n"
+        "    s = sorted(set(xs))\n"
+        "    for x in s:\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_dataflow_true_negative_reassigned():
+    # any non-set rebinding poisons the name: no false positive
+    src = (
+        "def emit(out, xs):\n"
+        "    s = set(xs)\n"
+        "    s = list(range(3))\n"
+        "    for x in s:\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_dataflow_true_negative_loop_target():
+    # a name that is also a for-target is not a tracked set
+    src = (
+        "def emit(out, xs):\n"
+        "    s = set(xs)\n"
+        "    for s in ([1], [2]):\n"
+        "        for x in s:\n"
+        "            out.write(str(x))\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_dataflow_set_local_sorted_at_site():
+    # sorting at the iteration site clears the tracked local too
+    src = (
+        "def emit(out, xs):\n"
+        "    s = set(xs)\n"
+        "    for x in sorted(s):\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_dataflow_augassign_preserves_set_kind():
+    src = (
+        "def emit(out, xs, ys):\n"
+        "    s = set(xs)\n"
+        "    s |= set(ys)\n"
+        "    for x in s:\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == ["DET003"]
+
+
+def test_det003_dataflow_dict_view_through_local_in_sink():
+    src = (
+        "import json\n\n"
+        "def emit(summary):\n"
+        "    view = summary.items()\n"
+        "    print(json.dumps([k for k, v in view]))\n"
+    )
+    assert "DET003" in rules_of(src, replay_critical=False)
+
+
+def test_det003_dataflow_true_negative_param_shadow():
+    # a parameter conditionally defaulted to a set stays untracked:
+    # the caller may pass a sorted list for it
+    src = (
+        "def emit(out, xs, s=None):\n"
+        "    if s is None:\n"
+        "        s = set(xs)\n"
+        "    for x in s:\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == []
+
+
+def test_det003_dataflow_scopes_are_separate():
+    # a set-typed name in one function must not taint a sibling's
+    src = (
+        "def a(out, xs):\n"
+        "    s = set(xs)\n"
+        "    return len(s)\n\n"
+        "def b(out, s):\n"
+        "    for x in s:\n"
+        "        out.write(str(x))\n"
+    )
+    assert rules_of(src) == []
+
+
 def test_det003_dict_view_in_sink():
     src = (
         "import json\n\n"

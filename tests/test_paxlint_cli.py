@@ -57,17 +57,9 @@ def allowed(state):
 
 
 def _env():
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        if not k.startswith(("JAX_", "XLA_"))
-    }
-    import __graft_entry__ as ge
+    from _subproc import scrubbed_env
 
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO] + ge.scrub_pythonpath(env.get("PYTHONPATH", ""))
-    )
-    return env
+    return scrubbed_env()
 
 
 def _lint(args, cwd):

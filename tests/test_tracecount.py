@@ -33,6 +33,76 @@ def test_census_counts_fresh_compile_once(compile_census):
     assert compile_census.total() >= 1  # session census saw it too
 
 
+def test_census_attributes_compiles_per_engine_scope():
+    """engine_scope() is the per-engine attribution axis: a compile
+    inside the scope lands on that engine's counter; one outside
+    lands on NO_ENGINE."""
+    local = tracecount.CompileCensus().start()
+    local.set_label("engine-probe")
+
+    @jax.jit
+    def scoped(x):
+        return (x * 2.75 - 3.5).sum() + 0.0625
+
+    @jax.jit
+    def unscoped(x):
+        return (x / 1.75 + 42.0).prod()
+
+    x = jnp.full((11, 5), 3.0)
+    with tracecount.engine_scope("probe-engine"):
+        scoped(x).block_until_ready()
+    unscoped(x).block_until_ready()
+    local.stop()
+    assert local.engine_counts.get("probe-engine", 0) == 1
+    assert local.engine_counts.get(tracecount.NO_ENGINE, 0) >= 1
+    assert "per engine scope" in local.report()
+    assert "probe-engine" in local.report()
+
+
+def test_engine_scope_nesting_attributes_to_innermost():
+    local = tracecount.CompileCensus().start()
+    local.set_label("engine-nest")
+
+    @jax.jit
+    def inner_fn(x):
+        return (x + 7.25).min() * 2.0
+
+    x = jnp.full((3, 3), 1.0)
+    with tracecount.engine_scope("outer"):
+        with tracecount.engine_scope("inner"):
+            inner_fn(x).block_until_ready()
+        assert tracecount.current_engine() == "outer"
+    local.stop()
+    assert local.engine_counts.get("inner", 0) >= 1
+    assert "outer" not in local.engine_counts
+    assert tracecount.current_engine() == tracecount.NO_ENGINE
+
+
+def test_run_state_compiles_under_sim_scope():
+    """The sim engine's entry point really wraps its compile: a fresh
+    tiny config compiled through run_state lands on the 'sim' engine
+    counter."""
+    import numpy as np
+
+    from tpu_paxos.config import SimConfig
+    from tpu_paxos.core import sim as simm
+    from tpu_paxos.utils import prng
+
+    cfg = SimConfig(n_nodes=3, n_instances=6, proposers=(0,),
+                    max_rounds=64, seed=3)
+    workload = [np.asarray([11, 12], np.int32)]
+    pend, gate, tail, c = simm.prepare_queues(cfg, workload, None)
+    root = prng.root_key(cfg.seed)
+    state = simm.init_state(cfg, pend, gate, tail, root)
+    local = tracecount.CompileCensus().start()
+    local.set_label("sim-scope-probe")
+    res = simm.run_state(cfg, state, root,
+                         np.asarray([11, 12], np.int32), c, vid_cap=0)
+    local.stop()
+    assert res.done
+    assert local.engine_counts.get("sim", 0) >= 1
+
+
 def test_census_stop_deactivates():
     local = tracecount.CompileCensus().start()
     local.set_label("stopped")
@@ -127,20 +197,14 @@ def test_enforcement_fails_run_with_named_culprit(tmp_path):
         "event": tracecount.COMPILE_EVENT,
         "budgets": {"tests/test_values.py": 0},
     }))
-    env = {
-        k: v for k, v in os.environ.items()
-        if not k.startswith(("JAX_", "XLA_", "TPU_PAXOS_COMPILE"))
-    }
-    import __graft_entry__ as ge
+    from _subproc import scrubbed_env
 
-    env["PYTHONPATH"] = os.pathsep.join(
-        [repo] + ge.scrub_pythonpath(env.get("PYTHONPATH", ""))
+    env = scrubbed_env(
+        extra_prefixes=("TPU_PAXOS_COMPILE",),
+        JAX_PLATFORMS="cpu",
+        TPU_PAXOS_COMPILE_CENSUS="1",
+        TPU_PAXOS_COMPILE_BUDGET=str(budget_path),
     )
-    env.update({
-        "JAX_PLATFORMS": "cpu",
-        "TPU_PAXOS_COMPILE_CENSUS": "1",
-        "TPU_PAXOS_COMPILE_BUDGET": str(budget_path),
-    })
     p = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/test_values.py", "-q",
          "-m", "not slow", "-p", "no:cacheprovider"],

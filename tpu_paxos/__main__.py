@@ -239,6 +239,8 @@ def run_fast(args) -> int:
 
     def _go():
         nonlocal n_devices
+        from tpu_paxos.analysis import tracecount
+
         if args.mesh:
             from tpu_paxos.parallel import mesh as pmesh
             from tpu_paxos.parallel import sharded
@@ -249,7 +251,8 @@ def run_fast(args) -> int:
             step = sharded.sharded_choose_all(mesh, proposer=0, quorum=quorum)
             return step(st, pmesh.shard_instances(mesh, vids))
         st = fast.init_state(n, args.srvcnt)
-        return fast.choose_all_jit(st, vids, proposer=0, quorum=quorum)
+        with tracecount.engine_scope("fast"):
+            return fast.choose_all_jit(st, vids, proposer=0, quorum=quorum)
 
     state, n_chosen = _with_trace(args, _go)
     if args.save_state:
@@ -530,6 +533,12 @@ def main(argv=None) -> int:
         from tpu_paxos.analysis import lint as lintm
 
         return lintm.main(argv[1:])
+    if argv and argv[0] == "audit":
+        # trace-time IR contracts + op/cost budget (needs jax: the
+        # provider modules are the engines; only --rules is jax-free)
+        from tpu_paxos.analysis import jaxpr_audit
+
+        return jaxpr_audit.main(argv[1:])
     args = build_parser().parse_args(argv)
     _select_backend(args.backend, args.mesh)
     if args.engine == "sim":

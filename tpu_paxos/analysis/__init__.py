@@ -25,16 +25,26 @@ pytest-recorded artifacts).  This subpackage enforces the contract
 - ``tracecount.py`` — the compile-census regression guard: counts XLA
   compilations during the tier-1 suite against the pinned per-module
   budget in ``compile_budget.json`` (the runtime shadow of the static
-  JAX rules).
+  JAX rules), attributed per test module AND per engine scope
+  (``engine_scope``);
+- ``registry.py`` / ``ir_rules.py`` / ``jaxpr_audit.py`` — the
+  trace-time tier: the auditable-entry-point registry (entries live
+  with the engines), IR-level rules IR201-IR205 over the traced
+  jaxprs, and the audit driver with the pinned op/cost budget
+  (``op_budget.json``, ``python -m tpu_paxos audit``).
 
-Import discipline: everything except ``tracecount`` is pure
-stdlib-AST and MUST import without jax (same lazy discipline as
-``core/__init__.py``) — ``make lint`` runs jax-free in well under
-10 s.  ``tracecount`` only touches jax inside ``CompileCensus.start``.
+Import discipline: everything except ``tracecount`` and
+``jaxpr_audit`` is pure stdlib and MUST import without jax (same lazy
+discipline as ``core/__init__.py``) — ``make lint`` runs jax-free in
+well under 10 s.  ``tracecount`` only touches jax inside
+``CompileCensus.start``/``engine_scope``; ``jaxpr_audit`` only inside
+the tracing functions (``ir_rules`` walks jaxprs duck-typed, without
+importing jax).
 """
 
 _SUBMODULES = (
-    "artifact_schema", "lint", "rules_det", "rules_jax", "tracecount",
+    "artifact_schema", "ir_rules", "jaxpr_audit", "lint", "registry",
+    "rules_det", "rules_jax", "tracecount",
 )
 
 

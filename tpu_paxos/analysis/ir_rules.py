@@ -1,0 +1,300 @@
+"""IR rule family: trace-time contracts on the engines' jaxprs.
+
+paxlint's AST rules (rules_det/rules_jax) see *source*; these rules
+see what JAX actually traced — the layer where a host sync hidden
+behind a helper, an accidental float64 widening, or a new cross-shard
+collective actually lives.  The checkers walk closed jaxprs (recursing
+into ``scan`` / ``while`` / ``cond`` / ``pjit`` / ``shard_map`` /
+``pallas_call`` sub-jaxprs) and report findings pinned to a primitive
+*path* (``sim.run_rounds/while/scan/convert_element_type``), so a
+violation names where in the traced program it sits, not just which
+Python file built it.
+
+Rules:
+
+- IR201  host-transfer / callback primitives (``pure_callback``,
+         ``io_callback``, ``debug_callback``, ``infeed`` / ``outfeed``,
+         ``device_put``...) inside a loop body (``scan`` / ``while``):
+         each firing is a per-iteration host round-trip — the
+         device-side round loop must stay host-free.
+- IR202  dtype widening past the engines' 32-bit lattice: any
+         equation output (or constvar) with a 64-bit or complex dtype.
+         The engines are int32/int8/bool machines; a float64/int64
+         leak changes decision bytes between backends.
+- IR203  collectives (``psum`` / ``pmax`` / ``all_gather`` /
+         ``ppermute``...) only where the entry declares mesh axes,
+         and only on those axes — a new collective in a single-chip
+         entry point, or one on an undeclared axis, is cross-replica
+         traffic the perf model doesn't know about.
+- IR204  ``sort``-class primitives with ``is_stable=False`` in a
+         replay-critical entry: unstable sort order is
+         backend/version-dependent and can reach decision bytes.
+         Waive per entry with ``allow=("IR204",)`` + a reason.
+- IR205  constant bloat: a jaxpr const larger than the entry's
+         ``const_budget`` — catches a fault table or host array baked
+         into the compiled program by accidental closure capture.
+
+Import discipline: the walkers duck-type jaxpr objects (``.eqns``,
+``.jaxpr``, ``.aval``) and never import jax — the module stays
+importable on jax-less CI images alongside the rest of the analysis
+package; only ``jaxpr_audit`` (which must trace) touches jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+RULES = {
+    "IR201": "host transfer/callback primitive inside a scanned/while "
+             "loop body",
+    "IR202": "dtype widening past the 32-bit lattice (float64/int64 "
+             "leak)",
+    "IR203": "collective primitive outside the entry's declared mesh "
+             "axes",
+    "IR204": "unstable sort in a replay-critical entry point",
+    "IR205": "oversized jaxpr constant (accidentally baked-in host "
+             "array)",
+}
+
+#: IR201: primitives that move data to/from the host (or call into
+#: it).  ``device_put`` inside a traced loop means a host value is
+#: re-staged per iteration.
+HOST_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "infeed", "outfeed",
+    "debug_print", "device_put",
+})
+
+#: IR203: cross-replica communication primitives.  ``axis_index`` is
+#: included: it binds the program to a mesh axis even though it moves
+#: no data, so it must be declared like the rest.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "pgather", "reduce_scatter", "axis_index",
+    "psum2", "all_gather_invariant",
+})
+
+#: IR202: allowed dtype names — the engines' declared lattice.  Keys
+#: (uint32 pairs) and float32 intermediates (PRNG uniforms, cost
+#: shaping) are legitimate; anything 64-bit or complex is a leak.
+DTYPE_LATTICE = frozenset({
+    "bool", "int8", "uint8", "int16", "uint16", "int32", "uint32",
+    "float32", "float16", "bfloat16", "float8_e4m3fn", "float8_e5m2",
+    "key<fry>",  # typed PRNG key aval (uint32 pair underneath)
+})
+
+#: Loop-entering primitives: their sub-jaxprs execute once per
+#: iteration (a while's cond jaxpr runs every iteration too).
+_LOOP_PRIMS = frozenset({"scan", "while"})
+
+
+@dataclasses.dataclass(frozen=True)
+class IRFinding:
+    """One IR-level finding, pinned to a primitive path."""
+
+    rule: str
+    entry: str  # audit entry name ("sim.run_rounds")
+    path: str   # primitive path ("sim.run_rounds/while/scan/convert_element_type")
+    message: str
+    hint: str
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "entry": self.entry,
+            "path": self.path,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+# ---------------- jaxpr walking (duck-typed) ----------------
+
+def _as_jaxpr(obj):
+    """Unwrap ClosedJaxpr -> Jaxpr; pass Jaxpr through; None for
+    anything else.  Duck-typed: a ClosedJaxpr has .jaxpr (+ .consts),
+    a Jaxpr has .eqns."""
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    if hasattr(obj, "eqns") and hasattr(obj, "invars"):
+        return obj
+    return None
+
+
+def sub_jaxprs(eqn):
+    """Sub-jaxprs referenced by an equation's params (scan/while
+    bodies, cond branches, pjit/shard_map/pallas_call inner jaxprs),
+    in deterministic param order."""
+    out = []
+    for key in sorted(eqn.params):
+        val = eqn.params[key]
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            j = _as_jaxpr(v)
+            if j is not None:
+                out.append(j)
+    return out
+
+
+def iter_eqns(jaxpr, path: str, in_loop: bool = False):
+    """Yield ``(eqn, path, in_loop)`` over a jaxpr and every nested
+    sub-jaxpr.  ``path`` accumulates primitive names; ``in_loop`` is
+    True once inside a scan/while sub-jaxpr (inherited downward)."""
+    j = _as_jaxpr(jaxpr)
+    if j is None:
+        return
+    for eqn in j.eqns:
+        name = eqn.primitive.name
+        yield eqn, path, in_loop
+        child_loop = in_loop or (name in _LOOP_PRIMS)
+        for sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub, f"{path}/{name}", child_loop)
+
+
+def iter_consts(jaxpr, path: str):
+    """Yield ``(const, path)`` for the top-level consts and every
+    nested ClosedJaxpr's consts."""
+    consts = getattr(jaxpr, "consts", None)
+    if consts:
+        for c in consts:
+            yield c, path
+    j = _as_jaxpr(jaxpr)
+    if j is None:
+        return
+    for eqn in j.eqns:
+        name = eqn.primitive.name
+        for key in sorted(eqn.params):
+            val = eqn.params[key]
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for v in vals:
+                if _as_jaxpr(v) is not None:
+                    yield from iter_consts(v, f"{path}/{name}")
+
+
+def _collective_axes(eqn) -> tuple[str, ...]:
+    """Named (string) axes a collective reduces/operates over.
+    Positional-int axes (vmap-internal) don't bind a mesh axis and
+    are ignored."""
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if axes is None:
+        axes = ()
+    if isinstance(axes, str):
+        axes = (axes,)
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _nbytes(const) -> int:
+    nb = getattr(const, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    size = getattr(const, "size", None)
+    itemsize = getattr(const, "itemsize", None)
+    if size is not None and itemsize is not None:
+        return int(size) * int(itemsize)
+    return 0
+
+
+def _dtype_name(aval) -> str | None:
+    dt = getattr(aval, "dtype", None)
+    return None if dt is None else str(dt)
+
+
+# ---------------- the checker ----------------
+
+def check_entry(entry, closed_jaxpr) -> list[IRFinding]:
+    """Run every IR rule over one entry's closed jaxpr.  ``entry`` is
+    an :class:`analysis.registry.AuditEntry`; findings waived by its
+    ``allow`` tuple are dropped (the trace-time pragma)."""
+    findings: list[IRFinding] = []
+    name = entry.name
+    declared = set(entry.mesh_axes)
+
+    for eqn, path, in_loop in iter_eqns(closed_jaxpr, name):
+        prim = eqn.primitive.name
+        ppath = f"{path}/{prim}"
+        if prim in HOST_PRIMS and in_loop:
+            findings.append(IRFinding(
+                "IR201", name, ppath,
+                f"host transfer/callback `{prim}` inside a traced loop "
+                "body — one host round-trip per simulated round",
+                "hoist the transfer out of the loop or express it as "
+                "device-side state; waive per entry with "
+                "allow=('IR201',) and a reason",
+            ))
+        if prim in COLLECTIVE_PRIMS:
+            axes = _collective_axes(eqn)
+            bad = [a for a in axes if a not in declared]
+            if not declared:
+                findings.append(IRFinding(
+                    "IR203", name, ppath,
+                    f"collective `{prim}` over axes {axes or '()'} in "
+                    "an entry point that declares no mesh axes",
+                    "collectives belong to the parallel/ entry points; "
+                    "declare mesh_axes on the AuditEntry if this "
+                    "surface is genuinely sharded",
+                ))
+            elif bad:
+                findings.append(IRFinding(
+                    "IR203", name, ppath,
+                    f"collective `{prim}` reduces over undeclared "
+                    f"axes {tuple(bad)} (declared: "
+                    f"{tuple(sorted(declared))})",
+                    "add the axis to the entry's mesh_axes if the new "
+                    "traffic is intentional — it changes the ICI/DCN "
+                    "cost model",
+                ))
+        if prim == "sort" and not eqn.params.get("is_stable", False):
+            findings.append(IRFinding(
+                "IR204", name, ppath,
+                "unstable `sort` in a replay-critical entry — tie "
+                "order is backend/version-dependent and can reach "
+                "decision bytes",
+                "pass is_stable=True (jnp.sort(kind='stable')), or "
+                "waive per entry with allow=('IR204',) and a proof "
+                "ties are impossible",
+            ))
+        for v in eqn.outvars:
+            dn = _dtype_name(getattr(v, "aval", None))
+            if dn is not None and dn not in DTYPE_LATTICE:
+                findings.append(IRFinding(
+                    "IR202", name, ppath,
+                    f"`{prim}` produces dtype {dn} — outside the "
+                    "32-bit lattice the engines declare",
+                    "find the widening input (Python int/float, x64 "
+                    "flag, np.int64 index) and cast at the source; "
+                    "64-bit values change decision bytes across "
+                    "backends",
+                ))
+                break  # one finding per equation is enough
+
+    for const, path in iter_consts(closed_jaxpr, name):
+        nb = _nbytes(const)
+        if nb > entry.const_budget:
+            shape = tuple(getattr(const, "shape", ()))
+            dt = getattr(const, "dtype", "?")
+            findings.append(IRFinding(
+                "IR205", name, f"{path}/<const>",
+                f"jaxpr constant of {nb} bytes ({dt}{list(shape)}) "
+                f"exceeds the entry's const budget "
+                f"({entry.const_budget})",
+                "a host array was baked in by closure capture — pass "
+                "it as an argument, or raise const_budget on the "
+                "AuditEntry if the table is intentional",
+            ))
+        dn = _dtype_name(const) or str(
+            getattr(const, "dtype", None) or ""
+        )
+        if dn and dn not in DTYPE_LATTICE:
+            findings.append(IRFinding(
+                "IR202", name, f"{path}/<const>",
+                f"jaxpr constant has dtype {dn} — outside the 32-bit "
+                "lattice",
+                "cast the captured table to an allowed dtype at its "
+                "definition site",
+            ))
+
+    waived = set(entry.allow)
+    return [f for f in findings if f.rule not in waived]

@@ -1,0 +1,567 @@
+"""jaxpr-audit: trace-time IR contracts + pinned op/cost budgets.
+
+``python -m tpu_paxos audit`` (or ``make audit``) traces every
+registered entry point of both engines and the sharded path
+(``analysis/registry.py`` — the registry itself lives with the
+engines) under canonical small configs, then:
+
+1. **IR rules** (``ir_rules.py``, IR201-IR205): walks the closed
+   jaxprs, recursing into scan/while/cond/pjit/shard_map sub-jaxprs,
+   and reports contract violations pinned to a primitive path.
+2. **Unregistered-function sweep**: statically finds every
+   ``jax.jit`` / ``pallas_call`` / ``shard_map`` surface in the
+   provider files and fails unless it is named by some entry's
+   ``covers`` or the module's ``AUDIT_EXEMPT`` — a new jitted surface
+   must opt in to the audit or CI goes red.
+3. **Op/cost census**: per-entry primitive counts (from the jaxpr —
+   backend-independent) and XLA ``cost_analysis`` FLOP / bytes
+   estimates (backend-dependent, enforced only against a budget
+   pinned on the same backend), checked against
+   ``analysis/op_budget.json`` with the same baseline / re-pin /
+   headroom machinery as the compile census: a PR that doubles an
+   engine's per-round HLO fails tier-1 naming the entry point and the
+   delta.  On a breach the offending entry's jaxpr is dumped to
+   ``stress-triage/`` (the repro-artifact dir convention) so the
+   culprit is diffable without rerunning.
+
+Re-pin workflow (intentional changes): ``TPU_PAXOS_OP_BUDGET_PIN=1
+python -m tpu_paxos audit`` (or ``--pin``) rewrites
+``op_budget.json`` from the measured census with headroom; commit the
+diff.  Tier-1 enforcement lives in ``tests/test_jaxpr_audit.py``,
+which runs this audit in-process against the committed budget.
+
+Import discipline: this module itself imports jax only inside the
+tracing functions, and ``ir_rules``/``registry`` never do — but
+collecting entries imports the provider modules (the engines), which
+need jax.  ``--rules`` and ``sweep_module`` stay fully jax-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from tpu_paxos.analysis import ir_rules, lint
+from tpu_paxos.analysis import registry as regm
+
+DEFAULT_BUDGET = os.path.join(os.path.dirname(__file__), "op_budget.json")
+
+#: Default triage dir — shared with the stress sweep's repro artifacts.
+DEFAULT_TRIAGE_DIR = "stress-triage"
+
+PIN_ENV = "TPU_PAXOS_OP_BUDGET_PIN"
+
+#: Call names whose appearance makes a jit surface (the sweep's
+#: definition of "jitted surface": a site where Python becomes a
+#: compiled XLA program).  Plain jit forms — including
+#: ``functools.partial(jax.jit, ...)`` — are detected via
+#: ``rules_jax._is_jit_expr``; this set adds the non-jit compile
+#: entries.
+_JIT_CALLS = frozenset({
+    "jax.jit", "jit", "jax.pjit", "pjit",
+    "pl.pallas_call", "pallas_call",
+    "shard_map", "jax.shard_map", "pmesh_shard_map", "pmesh.shard_map",
+})
+
+
+# ---------------- unregistered-function sweep (static, jax-free) ----
+
+def _surface_name(node: ast.AST) -> str:
+    """Name of the jit surface containing ``node``: the enclosing
+    function qualname (``MemberSim.__init__``), or the assignment
+    target for a module-level ``x = jax.jit(f)``."""
+    # module-level assignment target wins for top-level wraps
+    parent = getattr(node, "paxlint_parent", None)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1 and (
+        isinstance(parent.targets[0], ast.Name)
+    ):
+        grand = getattr(parent, "paxlint_parent", None)
+        if isinstance(grand, ast.Module):
+            return parent.targets[0].id
+    parts: list[str] = []
+    cur = parent
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parts.append(cur.name)
+        elif isinstance(cur, ast.ClassDef):
+            parts.append(cur.name)
+        cur = getattr(cur, "paxlint_parent", None)
+    return ".".join(reversed(parts)) if parts else "<module>"
+
+
+def sweep_module(path: str) -> set[str]:
+    """Statically-visible jit/pallas/shard_map surface names in one
+    provider file."""
+    from tpu_paxos.analysis import rules_jax
+
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    lint.attach_parents(tree)
+    surfaces: set[str] = set()
+    for node in ast.walk(tree):
+        hit = False
+        if isinstance(node, ast.Call):
+            # reuse the lint tier's jit detector: it knows the
+            # functools.partial(jax.jit, static_argnames=...) idiom
+            is_jit, _call = rules_jax._is_jit_expr(node)
+            hit = is_jit or lint.call_name(node) in _JIT_CALLS
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            # bare decorator form: @jax.jit
+            parent = getattr(node, "paxlint_parent", None)
+            if isinstance(parent, ast.FunctionDef) and node in getattr(
+                parent, "decorator_list", ()
+            ):
+                hit = lint.call_name(node) in _JIT_CALLS
+        if hit:
+            surfaces.add(_surface_name(node))
+    return surfaces
+
+
+def run_sweep(providers=regm.AUDIT_PROVIDERS, root: str | None = None,
+              entries: list | None = None) -> list[dict]:
+    """Cross-check static surfaces against registered coverage.
+    Returns a list of problem dicts (empty = clean).  Coverage is
+    scoped PER PROVIDER MODULE: an entry in core/sim.py covering
+    ``build_runner`` must not silently cover a same-named new surface
+    in another module, or the opt-in guarantee is gone.  (``entries``
+    is accepted for signature compatibility but coverage always comes
+    from each module's own ``audit_entries()``.)"""
+    del entries  # coverage is per-module by design; see docstring
+    problems: list[dict] = []
+
+    def is_covered(surface: str, names: set[str]) -> bool:
+        # prefix match: covering "_run_loop" also covers its nested
+        # defs ("_run_loop._go") — the jit site is inside the builder
+        return any(
+            surface == n or surface.startswith(n + ".") for n in names
+        )
+    root = root or os.getcwd()
+    for modname in providers:
+        mod = regm.provider_module(modname)
+        path = getattr(mod, "__file__", None)
+        if not path or not os.path.exists(path):
+            problems.append({
+                "kind": "missing_provider_file", "module": modname,
+                "detail": f"no source file for provider {modname}",
+            })
+            continue
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        exempt = regm.exemptions(mod)
+        prov = getattr(mod, "audit_entries", None)
+        covered: set[str] = set()
+        if prov is not None:
+            for e in prov():
+                covered.update(e.covers)
+        surfaces = sweep_module(path)
+        exempt_names = set(exempt)
+        for s in sorted(
+            s for s in surfaces
+            if not is_covered(s, covered) and not is_covered(s, exempt_names)
+        ):
+            problems.append({
+                "kind": "unregistered_surface", "module": modname,
+                "surface": s,
+                "detail": (
+                    f"jitted surface `{s}` in {relpath} is not named "
+                    "by this module's AuditEntry.covers nor "
+                    "AUDIT_EXEMPT — register an entry for it "
+                    "(analysis/registry.py)"
+                ),
+            })
+        for s in sorted(covered & exempt_names):
+            if s in surfaces:
+                problems.append({
+                    "kind": "double_booked_surface", "module": modname,
+                    "surface": s,
+                    "detail": f"`{s}` is both covered and exempt — "
+                    "drop one",
+                })
+    return problems
+
+
+# ---------------- tracing + census ----------------
+
+def trace_entry(entry):
+    """-> (closed_jaxpr, fn, args).  The one place jax is imported for
+    tracing; ``entry.x64`` wraps the trace in enable_x64 (fixtures)."""
+    import jax
+
+    fn, args = entry.build()
+    if entry.x64:
+        import jax.experimental
+
+        with jax.experimental.enable_x64():
+            return jax.make_jaxpr(fn)(*args), fn, args
+    return jax.make_jaxpr(fn)(*args), fn, args
+
+
+def op_census(closed_jaxpr) -> dict:
+    """Per-primitive counts over the whole (recursive) jaxpr."""
+    prims: dict[str, int] = {}
+    for eqn, _path, _loop in ir_rules.iter_eqns(closed_jaxpr, ""):
+        name = eqn.primitive.name
+        prims[name] = prims.get(name, 0) + 1
+    return {"ops": sum(prims.values()), "prims": dict(sorted(prims.items()))}
+
+
+def cost_estimate(entry, fn, args) -> dict:
+    """XLA cost_analysis of the lowered entry: flops / bytes accessed
+    (ints; 0-omitted).  Backend-dependent — the budget records which
+    backend pinned it and only enforces on a match."""
+    import jax
+
+    try:
+        lowered = (
+            fn.lower(*args) if hasattr(fn, "lower")
+            else jax.jit(fn).lower(*args)
+        )
+        ca = lowered.cost_analysis()
+    except Exception as e:  # lowering quirks must not kill the audit
+        return {"cost_error": type(e).__name__}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    if ca.get("flops"):
+        out["flops"] = int(ca["flops"])
+    if ca.get("bytes accessed"):
+        out["bytes"] = int(ca["bytes accessed"])
+    return out
+
+
+# ---------------- budget ----------------
+
+def load_budget(path: str = DEFAULT_BUDGET) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_budget(measured: dict[str, dict], path: str,
+                headroom: float = 0.3, slack: int = 8,
+                backend: str = "", keep: dict | None = None) -> dict:
+    """Pin the measured census: per-entry cap = ceil(v * (1+headroom))
+    + slack for each of ops/flops/bytes (same machinery as
+    compile_budget.json).  ``keep`` carries already-capped entries to
+    preserve verbatim (a partial re-pin must not drop the rest of the
+    committed budget)."""
+    cap = lambda v: int(v * (1 + headroom)) + slack  # noqa: E731
+    entries = dict(keep or {})
+    entries.update({
+        name: {
+            k: cap(v) for k, v in sorted(m.items())
+            if k in ("ops", "flops", "bytes")
+        }
+        for name, m in sorted(measured.items())
+    })
+    entries = dict(sorted(entries.items()))
+    data = {
+        "version": 1,
+        "backend": backend,
+        "headroom": headroom,
+        "slack": slack,
+        "entries": entries,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def check_budget(measured: dict[str, dict], budget: dict,
+                 backend: str = "") -> tuple[list[dict], list[str]]:
+    """-> (violations, stale).  ``ops`` caps are backend-independent
+    and always enforced; ``flops``/``bytes`` only when the budget was
+    pinned on the same backend.  Unpinned entries are violations
+    (nothing stays uncapped); budget entries for names no longer
+    registered are stale (the budget may only shrink with the code)."""
+    entries: dict = budget.get("entries", {})
+    same_backend = backend and budget.get("backend") == backend
+    violations: list[dict] = []
+    for name in sorted(measured):
+        m = measured[name]
+        caps = entries.get(name)
+        if caps is None:
+            violations.append({
+                "entry": name, "key": "ops", "measured": m.get("ops", 0),
+                "cap": None,
+                "detail": f"entry {name} has no pinned op budget — "
+                f"re-pin op_budget.json ({PIN_ENV}=1)",
+            })
+            continue
+        for key in ("ops", "flops", "bytes"):
+            if key in ("flops", "bytes") and not same_backend:
+                continue
+            if key in m and key in caps and m[key] > caps[key]:
+                violations.append({
+                    "entry": name, "key": key, "measured": m[key],
+                    "cap": caps[key],
+                    "detail": (
+                        f"entry {name}: {m[key]} {key} > budget "
+                        f"{caps[key]} (+{m[key] - caps[key]}) — the "
+                        "traced program grew; if intentional, re-pin "
+                        f"op_budget.json ({PIN_ENV}=1)"
+                    ),
+                })
+    stale = [n for n in sorted(entries) if n not in measured]
+    return violations, stale
+
+
+def dump_jaxpr(name: str, closed_jaxpr, triage_dir: str) -> str:
+    """Write the offending entry's jaxpr text under the triage dir
+    (the repro-artifact convention) so a budget breach is diffable
+    against a clean checkout without rerunning the audit."""
+    os.makedirs(triage_dir, exist_ok=True)
+    path = os.path.join(
+        triage_dir, f"jaxpr_{name.replace('/', '_').replace('.', '_')}.txt"
+    )
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(f"# jaxpr audit dump: entry {name}\n")
+        fh.write(str(closed_jaxpr))
+        fh.write("\n")
+    return path
+
+
+# ---------------- the audit ----------------
+
+def run_audit(
+    providers=regm.AUDIT_PROVIDERS,
+    budget_path: str | None = DEFAULT_BUDGET,
+    pin: bool = False,
+    triage_dir: str = DEFAULT_TRIAGE_DIR,
+    root: str | None = None,
+) -> dict:
+    """Full audit as a JSON-ready report dict.  ``ok`` iff zero IR
+    findings, a clean sweep, and the census within budget (or
+    ``budget_path=None`` / ``pin=True``)."""
+    import jax
+
+    backend = jax.default_backend()
+    entries = regm.collect(providers)
+    findings: list[ir_rules.IRFinding] = []
+    measured: dict[str, dict] = {}
+    jaxprs: dict[str, object] = {}
+    for entry in entries:
+        closed, fn, args = trace_entry(entry)
+        jaxprs[entry.name] = closed
+        findings.extend(ir_rules.check_entry(entry, closed))
+        census = op_census(closed)
+        if entry.cost:
+            census.update(cost_estimate(entry, fn, args))
+        measured[entry.name] = census
+    sweep = run_sweep(providers, root=root, entries=entries)
+
+    violations: list[dict] = []
+    stale: list[str] = []
+    dumped: list[str] = []
+    full = tuple(providers) == tuple(regm.AUDIT_PROVIDERS)
+    if pin:
+        path = budget_path or DEFAULT_BUDGET
+        # a scoped pin (fixture provider, one module) must not drop
+        # the other committed entries; only a full-registry pin may
+        # rewrite the file outright (that is what retires stale pins)
+        existing = load_budget(path)
+        keep = None if full else {
+            n: (caps if existing.get("backend") == backend
+                # kept flops/bytes caps were pinned on a different
+                # backend and the file is about to be re-tagged with
+                # this one — only the backend-independent ops cap
+                # stays comparable
+                else {k: v for k, v in caps.items() if k == "ops"})
+            for n, caps in existing.get("entries", {}).items()
+            if n not in measured
+        }
+        save_budget(measured, path, backend=backend, keep=keep)
+    elif budget_path:
+        violations, stale = check_budget(
+            measured, load_budget(budget_path), backend=backend
+        )
+        if not full:
+            # a scoped run never traced the other registered entries;
+            # only a full-registry audit may call a pin stale
+            stale = []
+        seen_dump: set[str] = set()
+        for v in violations:
+            name = v["entry"]
+            if name in jaxprs and name not in seen_dump:
+                seen_dump.add(name)
+                try:
+                    dumped.append(
+                        dump_jaxpr(name, jaxprs[name], triage_dir)
+                    )
+                except OSError:
+                    pass  # a read-only checkout must not mask the breach
+
+    report = {
+        "version": 1,
+        "backend": backend,
+        "entries": {
+            name: {
+                k: v for k, v in m.items() if k != "prims"
+            } | {"prims_top": dict(sorted(
+                m.get("prims", {}).items(),
+                key=lambda kv: (-kv[1], kv[0]))[:8])}
+            for name, m in sorted(measured.items())
+        },
+        "findings": [f.to_json() for f in findings],
+        "sweep": sweep,
+        "budget": {
+            "path": budget_path or "",
+            "pinned": bool(pin),
+            "violations": violations,
+            "stale": stale,
+            "dumped": sorted(set(dumped)),
+        },
+        "ok": not findings and not sweep and not violations and not stale,
+    }
+    return report
+
+
+# ---------------- CLI ----------------
+
+def _load_provider_arg(spec: str) -> tuple[str, ...]:
+    """--providers: comma-separated module names, or a path to a .py
+    file (loaded as a one-off module) — the fixture/golden path."""
+    names = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if item.endswith(".py") or os.sep in item:
+            import importlib.util
+            import sys
+
+            modname = "_audit_fixture_" + os.path.basename(item)[:-3]
+            s = importlib.util.spec_from_file_location(modname, item)
+            if s is None or s.loader is None:
+                raise FileNotFoundError(f"audit provider not found: {item}")
+            mod = importlib.util.module_from_spec(s)
+            sys.modules[modname] = mod
+            s.loader.exec_module(mod)
+            names.append(modname)
+        else:
+            names.append(item)
+    return tuple(names)
+
+
+def main(argv=None) -> int:
+    """``python -m tpu_paxos audit`` — exits 0 iff the traced tree
+    honors the IR contracts and the pinned op/cost budget."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_paxos audit",
+        description="jaxpr-audit: trace-time IR contracts + op/cost "
+                    "budget for the engines",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered entry points and exit "
+                    "(jax-free)")
+    ap.add_argument("--rules", action="store_true",
+                    help="list IR rule ids and exit")
+    ap.add_argument("--budget", default=DEFAULT_BUDGET,
+                    help="op/cost budget file (committed pins)")
+    ap.add_argument("--no-budget", action="store_true",
+                    help="skip budget enforcement (IR rules + sweep "
+                    "only)")
+    ap.add_argument("--pin", action="store_true",
+                    help=f"re-pin the budget from this run (also via "
+                    f"{PIN_ENV}=1); commit the diff")
+    ap.add_argument("--providers", default="",
+                    help="comma-separated provider modules or a .py "
+                    "path (default: the engine registry)")
+    ap.add_argument("--triage-dir", default=DEFAULT_TRIAGE_DIR,
+                    help="where breach jaxpr dumps go (repro-artifact "
+                    "dir convention)")
+    ap.add_argument("--backend", choices=("cpu", "tpu", "auto"),
+                    default="auto",
+                    help="jax platform for tracing (ops counts are "
+                    "backend-independent; flops/bytes pins are not)")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rid, doc in sorted(ir_rules.RULES.items()):
+            print(f"{rid}  {doc}")
+        return 0
+    providers = (
+        _load_provider_arg(args.providers) if args.providers
+        else regm.AUDIT_PROVIDERS
+    )
+    if args.list:
+        # static-only listing: provider modules import jax at module
+        # level, so "jax-free" here means no tracing, not no import
+        lines = []
+        for e in regm.collect(providers):
+            lines.append(
+                f"{e.name:<28s} covers={','.join(e.covers) or '-'} "
+                f"mesh_axes={','.join(e.mesh_axes) or '-'}"
+                + (f" allow={','.join(e.allow)}" if e.allow else "")
+            )
+        print("\n".join(lines))
+        return 0
+    if args.backend != "auto":
+        # env alone is too late when jax is preloaded (sitecustomize)
+        # or JAX_PLATFORMS is already exported — switch through
+        # jax.config like the rest of the repo's drivers
+        os.environ["JAX_PLATFORMS"] = args.backend
+        import jax
+
+        try:
+            # paxlint: allow[DET004] platform selection, value-neutral
+            jax.config.update("jax_platforms", args.backend)
+        except RuntimeError:
+            pass  # backend already initialized; env var did its best
+    # --no-budget disables the budget side entirely, pin included — a
+    # fixture/scoped run with TPU_PAXOS_OP_BUDGET_PIN exported must
+    # never rewrite the committed engine pins
+    pin = not args.no_budget and (
+        args.pin or os.environ.get(PIN_ENV, "") not in ("", "0")
+    )
+    try:
+        report = run_audit(
+            providers=providers,
+            budget_path=None if args.no_budget else args.budget,
+            pin=pin,
+            triage_dir=args.triage_dir,
+        )
+    except regm.RegistryError as e:
+        print(f"jaxpr-audit: {e}")
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for f in report["findings"]:
+            print(
+                f"{f['path']}: {f['rule']} {f['message']}\n"
+                f"    hint: {f['hint']}"
+            )
+        for p in report["sweep"]:
+            print(f"sweep: {p['detail']}")
+        for v in report["budget"]["violations"]:
+            print(f"budget: {v['detail']}")
+        for d in report["budget"]["dumped"]:
+            print(f"    jaxpr dumped: {d}")
+        for s in report["budget"]["stale"]:
+            print(
+                f"budget: stale entry {s} — no longer registered; "
+                "re-pin op_budget.json"
+            )
+        if pin:
+            print(f"op budget pinned to {args.budget} "
+                  f"({len(report['entries'])} entries, backend "
+                  f"{report['backend']})")
+        n = len(report["findings"])
+        print(
+            f"jaxpr-audit: {len(report['entries'])} entry points, "
+            f"{n} finding{'s' if n != 1 else ''}, "
+            f"{len(report['sweep'])} sweep problems, "
+            f"{len(report['budget']['violations'])} budget violations"
+        )
+    return 0 if report["ok"] else 1

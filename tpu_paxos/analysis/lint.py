@@ -194,9 +194,16 @@ def _suppressed(f: Finding, allowed: dict[int, set[str]]) -> bool:
 
 # ---------------- file walk & import closure ----------------
 
+#: Default lint scope: the package AND the test/example trees (tests
+#: assert on serialized engine output and examples are copy-paste
+#: templates — a nondeterministic pattern in either propagates).
+DEFAULT_SCOPE = ("tpu_paxos", "tests", "examples", "scripts")
+
+
 def walk_files(root: str, paths: list[str] | None = None) -> list[str]:
     """Python files to lint, as posix paths relative to ``root``.
-    Default target: the ``tpu_paxos`` package under ``root``."""
+    Default target: every ``DEFAULT_SCOPE`` directory that exists
+    under ``root`` (at minimum the ``tpu_paxos`` package)."""
     if paths:
         out: list[str] = []
         for p in paths:
@@ -219,13 +226,16 @@ def walk_files(root: str, paths: list[str] | None = None) -> list[str]:
         return sorted({
             os.path.relpath(f, root).replace(os.sep, "/") for f in out
         })
-    pkg = os.path.join(root, "tpu_paxos")
     out = []
-    for dirpath, _dirs, files in sorted(os.walk(pkg)):
-        out.extend(
-            os.path.join(dirpath, f) for f in sorted(files)
-            if f.endswith(".py")
-        )
+    for top in DEFAULT_SCOPE:
+        d = os.path.join(root, top)
+        if not os.path.isdir(d):
+            continue  # a bare package checkout still lints
+        for dirpath, _dirs, files in sorted(os.walk(d)):
+            out.extend(
+                os.path.join(dirpath, f) for f in sorted(files)
+                if f.endswith(".py")
+            )
     return sorted(
         os.path.relpath(f, root).replace(os.sep, "/") for f in out
     )

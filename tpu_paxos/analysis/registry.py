@@ -1,0 +1,138 @@
+"""Auditable-entry-point registry for the trace-time IR audit.
+
+The audit (``jaxpr_audit.py``) can only see what it traces, so every
+jitted surface of the engines must be *registered*: each provider
+module (the engines + the sharded path, ``AUDIT_PROVIDERS``) defines
+an ``audit_entries()`` function returning :class:`AuditEntry` objects
+— canonical small-config traces of its entry points.  The registry
+deliberately lives WITH the engines, not in a central table here:
+adding a jitted surface to an engine without registering it fails the
+audit's unregistered-function sweep (``jaxpr_audit._sweep_module``),
+which statically finds every ``jax.jit`` / ``pallas_call`` /
+``shard_map`` site in a provider file and requires its name to appear
+in some entry's ``covers`` tuple or in the module's ``AUDIT_EXEMPT``
+dict (name -> reason).
+
+Import discipline: this module is pure stdlib — an engine importing
+it must not pull jax transitively.  ``AuditEntry.build`` thunks (and
+``collect()``, which imports the engine providers) are only invoked
+by the audit itself, which owns the jax dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+#: Modules whose jitted surfaces are subject to the audit.  A new
+#: engine module with jitted entry points must be added here AND
+#: provide ``audit_entries()`` — the sweep runs over exactly this set,
+#: and tests/test_jaxpr_audit.py pins that each provider registers at
+#: least one entry.
+AUDIT_PROVIDERS = (
+    "tpu_paxos.core.sim",
+    "tpu_paxos.core.simkern",
+    "tpu_paxos.core.fast",
+    "tpu_paxos.membership.engine",
+    "tpu_paxos.parallel.sharded",
+    "tpu_paxos.parallel.sharded_sim",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditEntry:
+    """One auditable entry point: a canonical small-config trace.
+
+    ``build()`` returns ``(fn, args)``; the audit runs
+    ``jax.make_jaxpr(fn)(*args)`` for the IR rules and op census and
+    ``jax.jit(fn).lower(*args).cost_analysis()`` for the FLOP/bytes
+    estimates.  Building may allocate tiny device arrays; it must be
+    deterministic (fixed shapes, fixed seed) — the op budget is pinned
+    against exactly this trace.
+    """
+
+    #: Report/budget key, e.g. ``"sim.run_rounds"``.
+    name: str
+    #: () -> (fn, args) — the canonical trace.
+    build: Callable[[], tuple]
+    #: Statically-visible jit/pallas/shard_map surface names in the
+    #: provider file this entry exercises (function qualnames, or the
+    #: assignment target for module-level ``x = jax.jit(f)``).
+    covers: tuple = ()
+    #: Mesh axis names this entry's collectives may reduce over
+    #: (IR203).  Empty = collectives are forbidden in this entry.
+    mesh_axes: tuple = ()
+    #: Rule ids waived for this entry — the trace-time analog of the
+    #: paxlint pragma.  Give the reason in ``why``.
+    allow: tuple = ()
+    why: str = ""
+    #: Include XLA cost_analysis (flops / bytes accessed) in the
+    #: census.  Off for entries whose lowering is backend-exotic
+    #: (interpret-mode pallas) or whose cost numbers would be noise.
+    cost: bool = True
+    #: IR205 threshold: largest jaxpr constant (bytes) this entry may
+    #: bake in.  Engines bake small static tables (proposer maps,
+    #: schedule masks for canonical configs); a const past this is an
+    #: accidentally-captured host array.
+    const_budget: int = 65536
+    #: Trace under jax.experimental.enable_x64 — a fixture/testing
+    #: knob (the IR202 seeded-violation fixture needs 64-bit types to
+    #: exist); engine entries never set it.
+    x64: bool = False
+
+
+class RegistryError(Exception):
+    """A provider is malformed: missing audit_entries(), duplicate
+    entry names, or a non-AuditEntry in the returned list."""
+
+
+def provider_module(name: str):
+    """Import one provider module (jax import happens here — callers
+    that must stay jax-free use only the static sweep)."""
+    import importlib
+
+    return importlib.import_module(name)
+
+
+def exemptions(mod) -> dict[str, str]:
+    """The module's declared sweep exemptions: surface name -> reason.
+    An exemption documents a jitted surface that is deliberately not
+    audit-traced (e.g. a debug-only helper)."""
+    ex = getattr(mod, "AUDIT_EXEMPT", {})
+    if not isinstance(ex, dict):
+        raise RegistryError(
+            f"{mod.__name__}.AUDIT_EXEMPT must be a dict of "
+            "surface-name -> reason"
+        )
+    return ex
+
+
+def collect(providers=AUDIT_PROVIDERS) -> list[AuditEntry]:
+    """Import every provider and gather its registered entries.
+    Raises RegistryError on a provider without ``audit_entries()`` or
+    on duplicate entry names (budgets key on the name)."""
+    out: list[AuditEntry] = []
+    seen: dict[str, str] = {}
+    for name in providers:
+        mod = provider_module(name)
+        prov = getattr(mod, "audit_entries", None)
+        if prov is None:
+            raise RegistryError(
+                f"audit provider {name} defines no audit_entries() — "
+                "every engine module with jitted surfaces must "
+                "register its entry points (see analysis/registry.py)"
+            )
+        for e in prov():
+            if not isinstance(e, AuditEntry):
+                raise RegistryError(
+                    f"{name}.audit_entries() returned a non-AuditEntry: "
+                    f"{e!r}"
+                )
+            if e.name in seen:
+                raise RegistryError(
+                    f"duplicate audit entry name {e.name!r} "
+                    f"({seen[e.name]} and {name})"
+                )
+            seen[e.name] = name
+            out.append(e)
+    return out

@@ -21,6 +21,11 @@ wherever it lives; see lint.py for both definitions):
           ``join``/``list``/``tuple``/``*``-unpack), or iterating
           ``.items()``/``.keys()``/``.values()`` inside a sink
           function.  ``sorted(...)`` at the iteration site clears it.
+          Dataflow-aware through locals: ``s = set(...); for x in s``
+          is caught too — a name every assignment of which (in its
+          function scope) is a set expression / dict view carries
+          that kind to its iteration sites; any other rebinding
+          (non-set assignment, loop target, unpacking) clears it.
 - DET004  ``jax.config.update`` anywhere outside ``utils/prng.py`` —
           config flags can change sampled values (the PR 1 threefry
           incident), so the one sanctioned home is the prng module
@@ -80,6 +85,7 @@ def _pragma_hint(rule: str) -> str:
 def check_module(ctx: lint.ModuleContext) -> list[lint.Finding]:
     findings: list[lint.Finding] = []
     sink_cache: dict[ast.AST, bool] = {}
+    kinds_cache: dict[ast.AST, dict[str, str]] = {}
 
     def in_scope(node: ast.AST) -> bool:
         """DET001-003 scope: replay closure, or inside a sink fn."""
@@ -92,6 +98,14 @@ def check_module(ctx: lint.ModuleContext) -> list[lint.Finding]:
             sink_cache[fn] = lint.is_sink_function(fn)
         return sink_cache[fn]
 
+    def local_kinds(node: ast.AST) -> dict[str, str]:
+        """Set/dict-view locals of the scope containing ``node`` (the
+        dataflow side of DET003), computed once per scope."""
+        scope = lint.enclosing_function(node) or ctx.tree
+        if scope not in kinds_cache:
+            kinds_cache[scope] = _scope_kinds(scope)
+        return kinds_cache[scope]
+
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Call):
             name = lint.call_name(node)
@@ -102,7 +116,9 @@ def check_module(ctx: lint.ModuleContext) -> list[lint.Finding]:
             _check_config_update(ctx, node, name, findings)
         itered = _iterated_exprs(node)
         for expr in itered:
-            _check_unordered(ctx, node, expr, in_scope, findings)
+            _check_unordered(
+                ctx, node, expr, in_scope, findings, local_kinds
+            )
     return findings
 
 
@@ -175,12 +191,19 @@ def _iterated_exprs(node: ast.AST) -> list[ast.AST]:
     return out
 
 
-def _is_set_expr(expr: ast.AST) -> bool:
-    """Syntactic evidence that ``expr`` is a set (hash-ordered)."""
+_EMPTY_KINDS: dict[str, str] = {}
+
+
+def _is_set_expr(expr: ast.AST, kinds: dict[str, str] = _EMPTY_KINDS) -> bool:
+    """Syntactic evidence that ``expr`` is a set (hash-ordered).
+    ``kinds`` resolves local names the dataflow pass proved set-typed
+    (``s = set(...)`` ... ``s``)."""
     if isinstance(expr, ast.Set):
         return True
     if isinstance(expr, ast.SetComp):
         return True
+    if isinstance(expr, ast.Name):
+        return kinds.get(expr.id) == "set"
     if isinstance(expr, ast.Call):
         name = lint.call_name(expr)
         if name in ("set", "frozenset"):
@@ -196,17 +219,115 @@ def _is_set_expr(expr: ast.AST) -> bool:
     if isinstance(expr, ast.BinOp) and isinstance(
         expr.op, (ast.Sub, ast.BitAnd, ast.BitOr, ast.BitXor)
     ):
-        return _is_set_expr(expr.left) or _is_set_expr(expr.right)
+        return (
+            _is_set_expr(expr.left, kinds)
+            or _is_set_expr(expr.right, kinds)
+        )
     return False
 
 
-def _is_dict_view(expr: ast.AST) -> bool:
+def _is_dict_view(expr: ast.AST, kinds: dict[str, str] = _EMPTY_KINDS) -> bool:
+    if isinstance(expr, ast.Name):
+        return kinds.get(expr.id) == "dictview"
     return (
         isinstance(expr, ast.Call)
         and isinstance(expr.func, ast.Attribute)
         and expr.func.attr in _DICT_VIEW_METHODS
         and not expr.args
     )
+
+
+def _expr_kind(expr: ast.AST, kinds: dict[str, str]) -> str | None:
+    if _is_set_expr(expr, kinds):
+        return "set"
+    if _is_dict_view(expr, kinds):
+        return "dictview"
+    return None
+
+
+def _scope_kinds(scope: ast.AST) -> dict[str, str]:
+    """Dataflow pass for DET003: names in ``scope`` (a function or the
+    module; nested defs are separate scopes) whose EVERY binding is a
+    set expression or dict view.  Conservative by construction — any
+    other binding (non-set assignment, for-target, unpacking, walrus)
+    poisons the name, so a reassigned local never false-positives.
+    Name-to-name chains (``t = s``) resolve via a short fixpoint."""
+    walk = list(lint._walk_scope(scope))
+    # parameters are caller-controlled: a param conditionally shadowed
+    # by a set assignment (`if s is None: s = set(...)`) must never be
+    # tracked — the caller may pass a sorted list
+    always_bad: set[str] = set()
+    args = getattr(scope, "args", None)
+    if args is not None:
+        always_bad.update(
+            a.arg for a in args.posonlyargs + args.args + args.kwonlyargs
+        )
+        for va in (args.vararg, args.kwarg):
+            if va is not None:
+                always_bad.add(va.arg)
+    for node in walk:
+        if isinstance(node, ast.ExceptHandler) and node.name:
+            always_bad.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            always_bad.update(
+                (a.asname or a.name).split(".")[0] for a in node.names
+            )
+    kinds: dict[str, str] = {}
+    for _ in range(4):  # fixpoint for short assignment chains
+        bad: set[str] = set(always_bad)
+        new: dict[str, str] = {}
+
+        def bind(name: str, kind: str | None) -> None:
+            if kind is None or (name in new and new[name] != kind):
+                bad.add(name)
+            else:
+                new[name] = kind
+
+        for node in walk:
+            if isinstance(node, ast.Assign):
+                if len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    bind(node.targets[0].id, _expr_kind(node.value, kinds))
+                else:  # unpacking / chained / attribute targets
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                bad.add(n.id)
+            elif isinstance(node, ast.AnnAssign):
+                if isinstance(node.target, ast.Name):
+                    if node.value is not None:
+                        bind(node.target.id,
+                             _expr_kind(node.value, kinds))
+            elif isinstance(node, ast.AugAssign):
+                # |=/-=/&= preserve set-ness; any other aug on a
+                # tracked name poisons it
+                if isinstance(node.target, ast.Name) and not isinstance(
+                    node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)
+                ):
+                    bad.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        bad.add(n.id)
+            elif isinstance(node, ast.NamedExpr):
+                if isinstance(node.target, ast.Name):
+                    bind(node.target.id, _expr_kind(node.value, kinds))
+            elif isinstance(node, (ast.comprehension,)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        bad.add(n.id)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        for n in ast.walk(item.optional_vars):
+                            if isinstance(n, ast.Name):
+                                bad.add(n.id)
+        resolved = {n: k for n, k in new.items() if n not in bad}
+        if resolved == kinds:
+            break
+        kinds = resolved
+    return kinds
 
 
 def _order_consumed_safely(node: ast.AST) -> bool:
@@ -222,20 +343,26 @@ def _order_consumed_safely(node: ast.AST) -> bool:
     return False
 
 
-def _check_unordered(ctx, node, expr, in_scope, findings) -> None:
+def _check_unordered(ctx, node, expr, in_scope, findings,
+                     local_kinds=lambda node: _EMPTY_KINDS) -> None:
     if not in_scope(node):
         return
     if _order_consumed_safely(node):
         return
-    if _is_set_expr(expr):
+    kinds = local_kinds(node)
+    via = (
+        f" (local `{expr.id}` is set-typed by assignment)"
+        if isinstance(expr, ast.Name) else ""
+    )
+    if _is_set_expr(expr, kinds):
         findings.append(ctx.finding(
             "DET003", expr,
             "iteration over a set — hash order can escape into "
-            "logs/serialized bytes",
+            f"logs/serialized bytes{via}",
             "wrap in sorted(...) where the order leaves the process; "
             + _pragma_hint("DET003"),
         ))
-    elif _is_dict_view(expr):
+    elif _is_dict_view(expr, kinds):
         fn = lint.enclosing_function(node)
         if fn is not None and lint.is_sink_function(fn):
             findings.append(ctx.finding(

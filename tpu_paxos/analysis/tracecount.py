@@ -36,11 +36,63 @@ without jax.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 
 #: The jax.monitoring event recorded once per backend (XLA) compile.
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: Our own monitoring event, recorded on every engine-scope entry so
+#: external jax.monitoring backends see the scope boundaries too.
+ENGINE_SCOPE_EVENT = "/tpu_paxos/engine_scope"
+
+#: Label for compiles outside any engine scope (test scaffolding,
+#: fixture setup, host-side helpers).
+NO_ENGINE = "<outside-engine>"
+
+#: Active engine-scope stack (innermost last).  A plain module-level
+#: list, not a contextvar: engines drive compiles synchronously on
+#: the calling thread, and the census reads it from a synchronous
+#: monitoring callback.
+_ENGINE_STACK: list[str] = []
+
+
+@contextlib.contextmanager
+def engine_scope(name: str):
+    """Attribute XLA compiles inside the block to engine ``name``.
+
+    Engine entry points (core/sim.run_state, membership run_rounds,
+    the sharded runners) wrap their jitted calls in this scope, so the
+    compile census reports compiles per *engine* as well as per test
+    module — a retrace storm then names both the module that triggered
+    it and the engine whose cache key regressed.  Also records a
+    jax.monitoring event per entry (only when jax is already loaded —
+    the scope itself must stay usable, and cheap, without jax)."""
+    import sys
+
+    _ENGINE_STACK.append(name)
+    try:
+        mon = sys.modules.get("jax.monitoring")
+        if mon is not None:
+            try:
+                mon.record_event(ENGINE_SCOPE_EVENT, engine=name)
+            except TypeError:  # older record_event: no kwargs
+                try:
+                    mon.record_event(ENGINE_SCOPE_EVENT)
+                except Exception:
+                    pass
+            except Exception:
+                # a third-party monitoring listener must never break
+                # (or mislabel — the finally below pops) an engine run
+                pass
+        yield
+    finally:
+        _ENGINE_STACK.pop()
+
+
+def current_engine() -> str:
+    return _ENGINE_STACK[-1] if _ENGINE_STACK else NO_ENGINE
 
 DEFAULT_BUDGET = os.path.join(
     os.path.dirname(__file__), "compile_budget.json"
@@ -61,6 +113,9 @@ class CompileCensus:
     def __init__(self):
         self.counts: dict[str, int] = {}
         self.visited: set[str] = set()  # labels seen, even with 0 compiles
+        #: compiles per engine scope (engine_scope()), the per-engine
+        #: attribution axis — orthogonal to the per-module counts
+        self.engine_counts: dict[str, int] = {}
         self._label = STARTUP
         self._active = False
         self._registered = False
@@ -69,6 +124,8 @@ class CompileCensus:
     def _on_event(self, event: str, duration: float = 0.0, **kw) -> None:
         if self._active and event == COMPILE_EVENT:
             self.counts[self._label] = self.counts.get(self._label, 0) + 1
+            eng = current_engine()
+            self.engine_counts[eng] = self.engine_counts.get(eng, 0) + 1
 
     def start(self) -> "CompileCensus":
         if not self._registered:
@@ -131,6 +188,12 @@ class CompileCensus:
             for label, n in sorted(self.counts.items())
         )
         lines.append(f"  {'total':<40s} {self.total():>4d}")
+        if self.engine_counts:
+            lines.append("compile census (per engine scope):")
+            lines.extend(
+                f"  {eng:<40s} {n:>4d}"
+                for eng, n in sorted(self.engine_counts.items())
+            )
         return "\n".join(lines)
 
 

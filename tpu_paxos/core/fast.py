@@ -166,3 +166,22 @@ def choose_all(
 
 
 choose_all_jit = jax.jit(choose_all, static_argnames=("proposer", "quorum"))
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical trace of the fast path (analysis/registry.py)."""
+    from tpu_paxos.analysis.registry import AuditEntry
+
+    def build():
+        n, a = 16, 3
+        state = init_state(n, a)
+        vids = jnp.arange(n, dtype=jnp.int32)
+
+        def fn(state, vids):
+            return choose_all(state, vids, proposer=0, quorum=2)
+
+        return fn, (state, vids)
+
+    return [AuditEntry("fast.choose_all", build, covers=("choose_all_jit",))]

@@ -93,7 +93,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_paxos.config import SimConfig
+from tpu_paxos.analysis import tracecount
+from tpu_paxos.config import FaultConfig, SimConfig
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import faults as fltm
 from tpu_paxos.core import net as netm
@@ -1679,6 +1680,16 @@ def run_state(
         else:
             vid_cap = 0
     round_fn = build_engine(cfg, queue_cap, vid_cap=vid_cap)
+    _go = _run_loop(cfg, round_fn)
+    with tracecount.engine_scope("sim"):
+        final = _go(root, state)
+    return to_result(final, expected_vids)
+
+
+def _run_loop(cfg: SimConfig, round_fn):
+    """The jitted whole-run driver: while(not done and under the
+    round budget) round_fn.  Shared by ``run_state`` and the IR audit
+    (analysis/jaxpr_audit.py traces exactly this surface)."""
 
     @jax.jit
     def _go(root, state):
@@ -1690,8 +1701,7 @@ def run_state(
 
         return jax.lax.while_loop(cond, body, state)
 
-    final = _go(root, state)
-    return to_result(final, expected_vids)
+    return _go
 
 
 def to_result(final: SimState, expected_vids: np.ndarray) -> SimResult:
@@ -1733,3 +1743,45 @@ def run(
     return run_state(
         cfg, state, root, expected, c, vid_cap=gates_vid_cap(workload, gates)
     )
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_canonical_cfg() -> SimConfig:
+    """The canonical small config the IR audit traces this engine
+    under: multi-proposer with i.i.d. faults on, so the retry ladder,
+    crash masks, and fault sampling are all in the traced program
+    (what the op budget pins)."""
+    return SimConfig(
+        n_nodes=3,
+        n_instances=16,
+        proposers=(0, 1),
+        seed=0,
+        max_rounds=64,
+        faults=FaultConfig(drop_rate=500, crash_rate=1000),
+    )
+
+
+def audit_entries():
+    """Registered entry points for the trace-time IR audit (see
+    analysis/registry.py — a new jitted surface in this module must
+    be covered here or the audit's sweep fails)."""
+    from tpu_paxos.analysis.registry import AuditEntry
+
+    def build():
+        cfg = audit_canonical_cfg()
+        workload = default_workload(cfg)
+        pend, gate, tail, c = prepare_queues(cfg, workload, None)
+        root = prng.root_key(cfg.seed)
+        state = init_state(cfg, pend, gate, tail, root)
+        return _run_loop(cfg, build_engine(cfg, c, vid_cap=0)), (root, state)
+
+    return [AuditEntry(
+        "sim.run_rounds", build, covers=("_run_loop",),
+        allow=("IR204",),
+        why="conflict-requeue compaction sorts on provably-unique keys "
+            "(global instance ids / window offsets); instability cannot "
+            "reorder equal keys because there are none, and a stable "
+            "sort would pay for a third, hidden iota operand — see the "
+            "comment at the _sort_narrow/_sort_full sites",
+    )]

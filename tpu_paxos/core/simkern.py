@@ -189,3 +189,60 @@ def accum_acks(acks, cur_batch, acc_ballot, acc_vid, learned, ballot,
         interpret=interpret,
     )(scals, acks, cur_batch, acc_ballot, acc_vid, learned)
     return acks2, n_ack
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical one-tile traces of both kernels (interpret mode, so
+    the trace works on every backend; the IR rules recurse into the
+    pallas_call's inner jaxpr, which is where a kernel dtype leak
+    would live).  cost=False: interpret-mode lowering's flop counts
+    measure the interpreter, not the kernel."""
+    from tpu_paxos.analysis.registry import AuditEntry
+
+    a, p, i = 3, 2, TILE
+
+    def _acceptor_arrays():
+        acc_ballot = jnp.full((a, i), _B_NONE, jnp.int32)
+        acc_vid = jnp.full((a, i), _V_NONE, jnp.int32)
+        learned = jnp.full((a, i), _V_NONE, jnp.int32)
+        return acc_ballot, acc_vid, learned
+
+    def build_store():
+        acc_ballot, acc_vid, learned = _acceptor_arrays()
+        abat = jnp.zeros((p, i), jnp.int32)
+        abal = jnp.zeros((p,), jnp.int32)
+        elig = jnp.ones((p, a), jnp.bool_)
+
+        def fn(acc_ballot, acc_vid, learned, abat, abal, elig):
+            return store_accepts(
+                acc_ballot, acc_vid, learned, abat, abal, elig,
+                interpret=True,
+            )
+
+        return fn, (acc_ballot, acc_vid, learned, abat, abal, elig)
+
+    def build_ack():
+        acc_ballot, acc_vid, learned = _acceptor_arrays()
+        acks = jnp.zeros((p, a, i), jnp.int8)
+        cur_batch = jnp.zeros((p, i), jnp.int32)
+        ballot = jnp.zeros((p,), jnp.int32)
+        amatch = jnp.ones((p, a), jnp.bool_)
+
+        def fn(acks, cur_batch, acc_ballot, acc_vid, learned, ballot,
+               amatch):
+            return accum_acks(
+                acks, cur_batch, acc_ballot, acc_vid, learned, ballot,
+                amatch, interpret=True,
+            )
+
+        return fn, (acks, cur_batch, acc_ballot, acc_vid, learned,
+                    ballot, amatch)
+
+    return [
+        AuditEntry("simkern.store_accepts", build_store,
+                   covers=("store_accepts",), cost=False),
+        AuditEntry("simkern.accum_acks", build_ack,
+                   covers=("accum_acks",), cost=False),
+    ]

@@ -79,6 +79,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_paxos.analysis import tracecount
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import values as val
 from tpu_paxos.utils import prng
@@ -967,6 +968,10 @@ class MemberSim:
 
     # -- stepping --
     def run_rounds(self, k: int) -> None:
+        with tracecount.engine_scope("member"):
+            self._run_rounds(k)
+
+    def _run_rounds(self, k: int) -> None:
         for _ in range(k):
             self.state = self._round(self.state)
             if self.crash_rate:
@@ -1265,3 +1270,23 @@ class MemberSim:
 
     def learner_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.learners[viewer])).tolist())
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical trace of the membership round (analysis/registry.py):
+    crash_rate on, so the crash-admission sampling is in the traced
+    program the op budget pins."""
+    from tpu_paxos.analysis.registry import AuditEntry
+
+    def build():
+        n, i = 3, 8
+        c = i * 2 + 8
+        root = prng.root_key(0)
+        state = _init(n, i, c)
+        fn = _build_round(n, i, c, root, crash_rate=500, comp=None)
+        return fn, (state,)
+
+    return [AuditEntry("member.round", build,
+                       covers=("MemberSim.__init__",))]

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_paxos.analysis import tracecount
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import fast
 from tpu_paxos.core import values as val
@@ -82,7 +83,14 @@ def sharded_choose_all(mesh: Mesh, proposer: int, quorum: int):
         in_specs=(_state_specs(axes), P(axes)),
         out_specs=(_state_specs(axes), P()),
     )
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+
+    def step(state, vids):
+        with tracecount.engine_scope("sharded_fast"):
+            return jitted(state, vids)
+
+    step.lower = jitted.lower  # keep the AOT surface for benchmarks
+    return step
 
 
 def init_sharded_state(mesh: Mesh, n_instances: int, n_nodes: int) -> fast.FastState:
@@ -101,3 +109,27 @@ def init_sharded_state(mesh: Mesh, n_instances: int, n_nodes: int) -> fast.FastS
         is_leaf=lambda x: isinstance(x, P),
     )
     return jax.tree.map(jax.device_put, state, shardings)
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical sharded-fast-path trace (analysis/registry.py).  A
+    1-device mesh keeps the trace shape-identical however many
+    devices the host has; the collectives (pmax/psum over 'i') are in
+    the jaxpr regardless of mesh size, which is what IR203 checks."""
+    from tpu_paxos.analysis.registry import AuditEntry
+    from tpu_paxos.parallel import mesh as pmesh
+
+    def build():
+        mesh = pmesh.make_instance_mesh(1)
+        n = 16
+        state = init_sharded_state(mesh, n, n_nodes=3)
+        vids = pmesh.shard_instances(
+            mesh, jnp.arange(n, dtype=jnp.int32)
+        )
+        return sharded_choose_all(mesh, proposer=0, quorum=2), (state, vids)
+
+    return [AuditEntry("sharded.choose_all", build,
+                       covers=("sharded_choose_all",),
+                       mesh_axes=(INSTANCE_AXIS,))]

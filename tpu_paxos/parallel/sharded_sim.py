@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_paxos.analysis import tracecount
 from tpu_paxos.config import SimConfig
 from tpu_paxos.core import sim as simm
 from tpu_paxos.core import values as val
@@ -323,12 +324,18 @@ def build_runner(
             out_specs=specs,
         )
     )
+
+    def runner(root, st):
+        with tracecount.engine_scope("sharded_sim"):
+            return mapped(root, st)
+
+    runner.lower = mapped.lower  # keep the AOT surface for benchmarks
     expected = np.unique(
         np.concatenate(
             [np.asarray(w, np.int32).reshape(-1) for w in workload]
         )
     )
-    return mapped, root, state, expected
+    return runner, root, state, expected
 
 
 def to_result(final: simm.SimState, expected: np.ndarray) -> simm.SimResult:
@@ -345,3 +352,29 @@ def run_sharded(
     sharded over ``mesh`` — the sharded twin of ``core.sim.run``."""
     fn, root, state, expected = build_runner(cfg, mesh, workload, gates)
     return to_result(fn(root, state), expected)
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical sharded-general-engine trace (analysis/registry.py):
+    the full round ladder as the shard_map body, over a 1-device mesh
+    (shape-identical on any host; the cross-shard pmax/psum reductions
+    are in the trace regardless of mesh size)."""
+    from tpu_paxos.analysis.registry import AuditEntry
+    from tpu_paxos.core.sim import audit_canonical_cfg
+
+    def build():
+        cfg = audit_canonical_cfg()
+        mesh = pmesh.make_instance_mesh(1)
+        fn, root, state, _expected = build_runner(cfg, mesh)
+        return fn, (root, state)
+
+    return [AuditEntry(
+        "sharded_sim.run_rounds", build,
+        covers=("build_runner",),
+        mesh_axes=(INSTANCE_AXIS,),
+        allow=("IR204",),
+        why="same unique-key compaction sorts as sim.run_rounds (the "
+            "shard_map body IS core/sim's round_fn)",
+    )]
