@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-slow check lint lint-json audit audit-json bench \
 	bench-sharded parity parity-fast replay-diff replay-diff-member \
-	run stress stress-quick clean
+	run stress stress-quick fleet fleet-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -89,6 +89,22 @@ stress:
 # mixes: partition-flap, one-way, pause-heavy, pause-crash).
 stress-quick:
 	$(PY) -m tpu_paxos.harness.stress --seeds 2 --triage-dir stress-triage
+
+# Fleet schedule search: sample episode schedules from the seeded
+# grammar, run them as device-batched lanes (one XLA dispatch per
+# generation), shrink every wedge to a repro artifact under
+# stress-triage/.  LANES=n / GENS=n override the budget.
+fleet:
+	$(PY) -m tpu_paxos fleet --lanes $(or $(LANES),8) \
+	  --generations $(or $(GENS),4) --triage-dir stress-triage
+
+# Quick pass with the synthetic decision_round_max wedge knob armed:
+# slow-converging schedules count as wedges, so the find -> shrink ->
+# artifact -> `python -m tpu_paxos repro` path is exercised end to end
+# in one short run.
+fleet-quick:
+	$(PY) -m tpu_paxos fleet --lanes 8 --generations 1 --seed 2 \
+	  --decision-round-max 35 --max-wedges 1 --triage-dir stress-triage
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

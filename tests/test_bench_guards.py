@@ -96,6 +96,28 @@ def test_timed_sim_runs_withholds_nonconverged_value():
     assert all(c == i // 2 for c in rec["chosen_counts"]["timed"])
 
 
+def test_fleet_record_publishes_plausible_rate():
+    # 8 lanes of ~1 MiB state over >= 30 rounds in 100 ms: fine
+    rec = bench._fleet_record(
+        [0.100, 0.110, 0.120], 8 << 20, 30, 8, 1, {"devices": 1}
+    )
+    assert rec["value"] == pytest.approx(8 / 0.110, abs=0.005)  # 2-dp round
+    assert rec["unit"] == "lanes/sec"
+    assert len(rec["raw_timings_s"]) == 3
+
+
+def test_fleet_record_withholds_implausible_rate():
+    """A lying fleet timing (1 GiB of lane state x 1000 rounds in a
+    microsecond) must produce an error record with raw timings and NO
+    value — no roofline-clamped number is ever published."""
+    rec = bench._fleet_record(
+        [1e-6, 2e-6, 3e-6], 1 << 30, 1000, 64, 1, {"devices": 1}
+    )
+    assert "error" in rec and "roofline" in rec["error"]
+    assert "value" not in rec
+    assert rec["raw_timings_s"] == [0.0, 0.0, 0.0]
+
+
 def test_guard_headline_publishes_measured_rate():
     # 1 GiB state, 10 ms median: plausible — median rate published
     rate, upper, note = bench._guard_headline(

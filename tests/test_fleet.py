@@ -1,0 +1,318 @@
+"""Fleet runner (tpu_paxos/fleet/): lane-for-lane decision-log parity
+with the single-run engine across every episode-mix kind, on-device
+verdict correctness, and the search -> shrink -> repro pipeline."""
+
+import hashlib
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import sim as simm
+from tpu_paxos.fleet import runner as frun
+from tpu_paxos.fleet import search as fsearch
+from tpu_paxos.fleet import verdict as vdt
+from tpu_paxos.harness import shrink as shr
+from tpu_paxos.replay.decision_log import decision_log
+
+# One schedule per episode kind (partition / one-way / pause+burst /
+# none) — small horizons keep the runs short while exercising every
+# runtime-mask dimension.
+SCHEDS = [
+    flt.FaultSchedule((flt.partition(5, 20, (0, 1), (2, 3, 4)),)),
+    flt.FaultSchedule((flt.one_way(5, 25, (0,), (2, 3)),)),
+    flt.FaultSchedule((flt.pause(4, 20, 1), flt.burst(8, 18, 2000))),
+    None,
+]
+
+WL = [np.arange(100, 110, dtype=np.int32),
+      np.arange(200, 210, dtype=np.int32)]
+
+
+def _cfg(seed=0, schedule=None, crash_rate=0):
+    return SimConfig(
+        n_nodes=5, n_instances=64, proposers=(0, 1), seed=seed,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=300, dup_rate=500, max_delay=2,
+                           crash_rate=crash_rate, schedule=schedule),
+    )
+
+
+def _log_sha(r, workload, n_instances):
+    stride = int(max(int(np.max(w)) for w in workload)) + 1
+    text = decision_log(
+        r.chosen_vid, r.chosen_ballot, stride=stride,
+        n_instances=n_instances,
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+LANES = [(sched, seed) for sched in SCHEDS for seed in (0, 1)]
+
+
+@pytest.fixture(scope="module")
+def fleet_fixture():
+    """One compiled runner + one 8-lane dispatch (all four
+    episode-mix kinds x 2 seeds) shared across this module — the
+    fleet compile is the expensive part, and one dispatch IS the
+    subsystem's unit."""
+    runner = frun.FleetRunner(_cfg(), WL)
+    rep = runner.run(
+        [seed for _, seed in LANES], [sched for sched, _ in LANES]
+    )
+    return runner, rep
+
+
+@pytest.fixture
+def fleet_rep(fleet_fixture):
+    return fleet_fixture[1]
+
+
+def test_fleet_parity_all_mixes(fleet_rep):
+    """THE fleet contract: >= 8 lanes spanning all four episode-mix
+    kinds produce, lane for lane, the same decision-log sha256 as
+    single core/sim.run executions of the same (cfg, schedule, seed)
+    — one compiled executable vs four schedule-specialized ones.
+    (The single-run side compiles once per schedule and reuses the
+    executable across seeds, the stress sweep's pattern.)"""
+    import jax
+
+    from tpu_paxos.utils import prng
+
+    lanes = LANES
+    rep = fleet_rep
+    assert rep.n_lanes == 8
+    assert rep.verdict.ok.all(), rep.verdict
+    expected = np.unique(np.concatenate(WL))
+    i = 0
+    for sched in SCHEDS:
+        cfg = _cfg(schedule=sched)
+        pend, gate, tail, c = simm.prepare_queues(cfg, WL)
+        round_fn = simm.build_engine(cfg, c, vid_cap=0)
+
+        @jax.jit
+        def go(root, st, _rf=round_fn, _mr=cfg.round_budget):
+            return jax.lax.while_loop(
+                lambda x: (~x.done) & (x.t < _mr),
+                lambda x: _rf(root, x),
+                st,
+            )
+
+        for seed in (0, 1):
+            root = prng.root_key(seed)
+            state = simm.init_state(cfg, pend, gate, tail, root)
+            single_r = simm.to_result(go(root, state), expected)
+            lane_r = rep.lane_result(i)
+            assert lane_r.rounds == single_r.rounds, f"lane {i}"
+            assert _log_sha(lane_r, WL, 64) == _log_sha(single_r, WL, 64), (
+                f"lane {i} (schedule {sched}, seed {seed}) decision "
+                "log diverges from the single-run engine"
+            )
+            i += 1
+    # lane_cfg round-trips the per-lane (schedule, seed) back into a
+    # single-run config — the shrink hand-off's input
+    c0 = rep.lane_cfg(0)
+    assert c0.seed == 0 and c0.faults.schedule == SCHEDS[0]
+    assert rep.lane_cfg(7).faults.schedule is None
+    assert rep.lane_cfg(7).seed == 1
+
+
+def test_runner_rejects_baked_schedule_and_bad_lane_counts():
+    with pytest.raises(ValueError, match="per-lane runtime tables"):
+        frun.FleetRunner(_cfg(schedule=SCHEDS[0]), WL)
+    runner = frun.FleetRunner(_cfg(), WL)
+    with pytest.raises(ValueError, match="one schedule per lane"):
+        runner.run([0, 1], [None])
+
+
+def test_per_lane_workloads_same_template(fleet_fixture):
+    """Per-lane (workload, gates) pairs — the stress --fleet path,
+    where each seed's workload shuffles the same vid set — stack into
+    the runner's compiled shapes (reusing the shared dispatch's
+    executable; only the lane count retraces) and still produce
+    green, template-judged lanes."""
+    runner, _ = fleet_fixture
+    wl_rev = [w[::-1].copy() for w in WL]  # same vids, shuffled order
+    per_lane = [(WL, None), (wl_rev, None)] * 4  # keep the 8-lane shape
+    rep = runner.run(
+        [seed for _, seed in LANES], [sched for sched, _ in LANES],
+        workloads=per_lane,
+    )
+    assert rep.verdict.ok.all(), rep.verdict
+
+
+def test_runner_rejects_workload_changing_expected_set():
+    runner = frun.FleetRunner(_cfg(), WL)
+    other = [np.arange(300, 310, dtype=np.int32),
+             np.arange(400, 410, dtype=np.int32)]
+    with pytest.raises(ValueError, match="expected-vid set"):
+        runner.run([0], [None], workloads=[(other, None)])
+    # same vid SET but a value swapped between proposers: the verdict's
+    # crash-excusal owner map would be wrong — must be rejected too
+    swapped = [w.copy() for w in WL]
+    swapped[0][0], swapped[1][0] = WL[1][0], WL[0][0]
+    with pytest.raises(ValueError, match="owner"):
+        runner.run([0], [None], workloads=[(swapped, None)])
+
+
+def test_mesh_tile_bitwise_parity(fleet_fixture):
+    """The shard_map lane tile (2 of the conftest's 8 virtual CPU
+    devices) must produce bitwise-identical per-lane results to the
+    unmeshed vmap — lanes are independent, so the tile is pure
+    placement."""
+    import jax
+
+    from tpu_paxos.parallel import mesh as pmesh
+
+    _, rep = fleet_fixture
+    mesh = pmesh.make_instance_mesh(2)
+    assert mesh.size == 2
+    runner_m = frun.FleetRunner(_cfg(), WL, mesh=mesh)
+    rep_m = runner_m.run(
+        [seed for _, seed in LANES], [sched for sched, _ in LANES]
+    )
+    for f in ("ok", "rounds", "max_round"):
+        assert (getattr(rep_m.verdict, f) == getattr(rep.verdict, f)).all()
+    for a, b in zip(jax.tree.leaves(rep_m.final), jax.tree.leaves(rep.final)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    # lanes that don't tile the mesh are rejected up front
+    with pytest.raises(ValueError, match="tile"):
+        runner_m.run([0], [None])
+
+
+# ---------------- on-device verdict ----------------
+
+
+def test_verdict_green_and_each_red_dimension(fleet_rep):
+    """Doctor a green lane from the shared dispatch (lane 7: no
+    schedule) along each verdict dimension — no extra compile."""
+    import jax
+
+    cfg = _cfg()
+    final = jax.tree.map(lambda x: x[7], fleet_rep.final)
+    expected, owner = vdt.expected_owners(cfg, WL)
+    v = vdt.lane_verdict(cfg, final, expected, owner)
+    assert bool(v.ok) and bool(v.agreement) and bool(v.coverage)
+    assert bool(v.quiescent)
+
+    # agreement: two nodes learn different values for one instance
+    bad_learned = final.learned.at[0, 0].set(100).at[1, 0].set(101)
+    v2 = vdt.lane_verdict(
+        cfg, final._replace(learned=bad_learned), expected, owner
+    )
+    assert not bool(v2.agreement) and not bool(v2.ok)
+
+    # coverage: erase one expected value from the chosen set
+    gone = int(expected[0])
+    cv = jnp.where(final.met.chosen_vid == gone, jnp.int32(-1),
+                   final.met.chosen_vid)
+    v3 = vdt.lane_verdict(
+        cfg, final._replace(met=final.met._replace(chosen_vid=cv)),
+        expected, owner,
+    )
+    assert not bool(v3.coverage) and not bool(v3.ok)
+
+    # ...but a crashed owner excuses its values
+    crashed = final.crashed.at[int(owner[0])].set(True)
+    v4 = vdt.lane_verdict(
+        cfg,
+        final._replace(
+            met=final.met._replace(chosen_vid=cv), crashed=crashed
+        ),
+        expected, owner,
+    )
+    assert bool(v4.coverage)
+
+    # quiescence: done=False is red unless every proposer crashed
+    v5 = vdt.lane_verdict(
+        cfg, final._replace(done=jnp.bool_(False)), expected, owner
+    )
+    assert not bool(v5.quiescent) and not bool(v5.ok)
+    all_crashed = final.crashed.at[0].set(True).at[1].set(True)
+    v6 = vdt.lane_verdict(
+        cfg,
+        final._replace(done=jnp.bool_(False), crashed=all_crashed),
+        expected, owner,
+    )
+    assert bool(v6.quiescent)
+
+
+# ---------------- grammar + search ----------------
+
+
+def test_sample_schedule_is_seeded_and_valid():
+    rng1 = np.random.default_rng(5)
+    rng2 = np.random.default_rng(5)
+    s1 = [fsearch.sample_schedule(rng1, 5, 4, 96) for _ in range(16)]
+    s2 = [fsearch.sample_schedule(rng2, 5, 4, 96) for _ in range(16)]
+    assert s1 == s2  # same seed -> same grammar draws
+    kinds = set()
+    for s in s1:
+        assert 1 <= len(s.episodes) <= 4
+        assert s.horizon <= 96
+        for e in s.episodes:
+            kinds.add(e.kind)
+            flt.validate_episode(e, 5)  # every draw is encodable
+        stm_tabs = __import__(
+            "tpu_paxos.fleet.schedule_table", fromlist=["encode_schedule"]
+        ).encode_schedule(s, 5, 4)
+        assert int(stm_tabs.horizon) == s.horizon
+    assert kinds == set(fsearch.KINDS)  # 16 draws cover the grammar
+
+
+@pytest.mark.slow
+def test_search_finds_wedges():
+    """A tight decision_round_max turns slow-converging sampled
+    schedules into wedges the search must find and confirm through
+    the single-run engine (triage disabled here — the shrink +
+    artifact + repro leg is the test below and `make fleet-quick`;
+    the grammar itself is covered fast-tier above)."""
+    summary = fsearch.search(
+        n_lanes=4, generations=1, base_seed=2,
+        triage_dir=None, decision_round_max=35,
+        max_episodes=2, horizon=48, max_wedges=1, verbose=False,
+    )
+    assert summary["wedges_found"] >= 1, summary
+    assert not summary["anomalies"], summary["anomalies"]
+    assert summary["ok"]  # synthetic wedges are not real violations
+    w = summary["wedges"][0]
+    assert w["synthetic"] and "decision_round_max" in w["violation"]
+    assert summary["lanes_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_search_shrinks_and_artifact_reproduces(tmp_path):
+    """The fleet-quick acceptance shape in miniature: find a wedge,
+    shrink it, and the artifact replays byte-identically through the
+    triage stack."""
+    summary = fsearch.search(
+        n_lanes=4, generations=1, base_seed=2,
+        triage_dir=str(tmp_path), decision_round_max=35,
+        max_episodes=2, horizon=48, max_wedges=1, verbose=False,
+    )
+    assert summary["wedges_found"] >= 1, summary
+    art = summary["wedges"][0].get("artifact")
+    assert art, summary["wedges"][0]
+    rep = shr.reproduce(art)
+    assert rep["match"], rep
+    loaded = json.loads(open(art).read())
+    assert "decision_round_max" in loaded["violation"]
+
+
+@pytest.mark.slow
+def test_fleet_cli_end_to_end(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_paxos", "fleet", "--lanes", "2",
+         "--generations", "1", "--quiet", "--backend", "cpu"],
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["metric"] == "fleet_search"
+    assert summary["lanes_total"] == 2
+    assert summary["lanes_per_sec"] > 0

@@ -1,0 +1,159 @@
+"""Runtime schedule-table encoding (fleet/schedule_table.py): the
+table-encoded per-round masks must equal core/faults.compile_schedule's
+compiled rows for every episode kind and edge case — that equality is
+what makes a fleet lane decision-log-identical to a single run."""
+
+import numpy as np
+import pytest
+
+from tpu_paxos.core import faults as flt
+from tpu_paxos.fleet import schedule_table as stm
+from tpu_paxos.harness import stress
+
+
+def _assert_masks_match(sched, n_nodes, pad=None, extra_rounds=4):
+    comp = flt.compile_schedule(sched, n_nodes)
+    tab = stm.encode_schedule(sched, n_nodes, max_episodes=pad)
+    horizon = comp.horizon if comp is not None else 0
+    assert int(tab.horizon) == horizon
+    for t in range(horizon + extra_rounds):
+        reach, paused, extra = stm.masks_at(tab, t)
+        if comp is None:
+            assert np.asarray(reach).all()
+            assert not np.asarray(paused).any()
+            assert int(extra) == 0
+            continue
+        tt = min(t, horizon)
+        assert (np.asarray(reach) == comp.reach[tt]).all(), f"reach @ t={t}"
+        assert (np.asarray(paused) == comp.paused[tt]).all(), f"paused @ t={t}"
+        assert int(extra) == int(comp.extra_drop[tt]), f"extra @ t={t}"
+
+
+@pytest.mark.parametrize(
+    "sched",
+    [
+        stress.SCHED_PARTITION_FLAP,
+        stress.SCHED_ONE_WAY,
+        stress.SCHED_PAUSE_HEAVY,
+        stress.SCHED_PAUSE_CRASH,
+    ],
+    ids=["partition-flap", "one-way", "pause-heavy", "pause-crash"],
+)
+def test_stress_mix_schedules_match_compiled_tables(sched):
+    _assert_masks_match(sched, 5)
+
+
+def test_every_kind_with_padding():
+    sched = flt.FaultSchedule((
+        flt.partition(2, 9, (0, 1), (2,)),
+        flt.one_way(3, 12, (0, 4), (1,)),
+        flt.pause(1, 7, 3),
+        flt.burst(4, 10, 2500),
+    ))
+    _assert_masks_match(sched, 5)
+    # a larger episode capacity pads with never-active slots — masks
+    # unchanged
+    _assert_masks_match(sched, 5, pad=8)
+
+
+def test_empty_schedule_is_all_clear():
+    _assert_masks_match(None, 5)
+    _assert_masks_match(flt.FaultSchedule(()), 3)
+    tab = stm.encode_schedule(None, 3)
+    assert int(tab.horizon) == 0
+    assert tab.t0.shape == (1,)  # min capacity 1 so batches stack
+
+
+def test_touching_intervals():
+    """Back-to-back episodes over [0,5) and [5,10): round 5 must read
+    the first healed and the second active — half-open semantics."""
+    sched = flt.FaultSchedule((
+        flt.partition(0, 5, (0,), (1, 2)),
+        flt.partition(5, 10, (0, 1), (2,)),
+    ))
+    _assert_masks_match(sched, 3)
+    tab = stm.encode_schedule(sched, 3)
+    reach, _, _ = stm.masks_at(tab, 5)
+    reach = np.asarray(reach)
+    assert reach[0, 1] and reach[1, 0]  # first episode healed
+    assert not reach[0, 2] and not reach[1, 2]  # second active
+
+
+def test_full_mesh_partition():
+    """Every node its own group: only the diagonal survives."""
+    sched = flt.FaultSchedule((
+        flt.partition(0, 6, (0,), (1,), (2,), (3,), (4,)),
+    ))
+    _assert_masks_match(sched, 5)
+    tab = stm.encode_schedule(sched, 5)
+    reach, _, _ = stm.masks_at(tab, 3)
+    assert (np.asarray(reach) == np.eye(5, dtype=bool)).all()
+
+
+def test_overlapping_bursts_add_and_clamp():
+    sched = flt.FaultSchedule((
+        flt.burst(0, 10, 6000),
+        flt.burst(5, 15, 6000),
+    ))
+    _assert_masks_match(sched, 3)
+    tab = stm.encode_schedule(sched, 3)
+    _, _, extra = stm.masks_at(tab, 7)
+    assert int(extra) == 10_000  # 12000 clamps like the compiled path
+
+
+def test_one_way_self_edge_never_cut():
+    """src and dst overlapping must not cut a node's self-reach (the
+    compiled path restores the diagonal after applying cuts)."""
+    sched = flt.FaultSchedule((flt.one_way(0, 5, (0, 1), (0, 2)),))
+    _assert_masks_match(sched, 3)
+    tab = stm.encode_schedule(sched, 3)
+    reach, _, _ = stm.masks_at(tab, 2)
+    assert np.asarray(reach).diagonal().all()
+
+
+def test_encode_batch_stacks_independent_lanes():
+    scheds = [
+        flt.FaultSchedule((flt.pause(2, 8, 1),)),
+        None,
+        flt.FaultSchedule((
+            flt.partition(1, 4, (0,), (1, 2)), flt.burst(2, 6, 1000),
+        )),
+    ]
+    tabs = stm.encode_batch(scheds, 3)
+    assert tabs.t0.shape == (3, 2)  # capacity = max episodes over lanes
+    assert tabs.horizon.tolist() == [8, 0, 6]
+    for i, s in enumerate(scheds):
+        one = stm.ScheduleTable(*(getattr(tabs, f)[i]
+                                  for f in stm.ScheduleTable._fields))
+        comp = flt.compile_schedule(s, 3)
+        for t in range(10):
+            reach, paused, extra = stm.masks_at(one, t)
+            if comp is None:
+                assert np.asarray(reach).all() and int(extra) == 0
+            else:
+                tt = min(t, comp.horizon)
+                assert (np.asarray(reach) == comp.reach[tt]).all()
+                assert (np.asarray(paused) == comp.paused[tt]).all()
+                assert int(extra) == int(comp.extra_drop[tt])
+
+
+def test_capacity_overflow_rejected():
+    sched = flt.FaultSchedule((flt.pause(0, 4, 1), flt.pause(2, 6, 0)))
+    with pytest.raises(ValueError, match="capacity"):
+        stm.encode_schedule(sched, 3, max_episodes=1)
+
+
+def test_node_range_validated_like_compile_schedule():
+    sched = flt.FaultSchedule((flt.pause(0, 4, 7),))
+    with pytest.raises(ValueError, match="cluster has 3 nodes"):
+        stm.encode_schedule(sched, 3)
+    with pytest.raises(ValueError, match="cluster has 3 nodes"):
+        flt.compile_schedule(sched, 3)
+
+
+def test_degenerate_partition_validated_like_compile_schedule():
+    sched = flt.FaultSchedule((flt.partition(0, 4, (0, 1, 2)),))
+    with pytest.raises(ValueError, match="implicit complement"):
+        stm.encode_schedule(sched, 3)
+    with pytest.raises(ValueError, match="implicit complement"):
+        flt.compile_schedule(sched, 3)

@@ -18,6 +18,35 @@ def test_stress_sweep_smoke(monkeypatch):
 
 
 @pytest.mark.slow
+def test_stress_fleet_sweep_smoke():
+    """One episode mix, 2 seeds, through the FLEET runner: both seeds
+    ride one device dispatch, the on-device verdict passes both, and
+    the summary reports lanes/sec alongside the seed count.  (The
+    per-lane-workload stacking it relies on is covered fast-tier by
+    tests/test_fleet.py::test_per_lane_workloads_same_template.)"""
+    summary = stress.sweep_fleet(
+        n_seeds=2, verbose=False, mixes=stress.EPISODE_MIXES[:1]
+    )
+    assert summary["ok"], summary["failures"]
+    assert summary["runs"] == 2
+    assert summary["lanes"] == 2
+    assert summary["seeds_per_mix"] == 2
+    assert summary["lanes_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_stress_fleet_matches_host_loop(monkeypatch):
+    """The --fleet route must judge exactly the runs the host loop
+    judges: same (mix, seed) grid, both green — and the fleet's lanes
+    ARE those runs (decision-log parity pinned in test_fleet.py)."""
+    mixes = stress.EPISODE_MIXES[:2]
+    host = stress.sweep(n_seeds=2, verbose=False, mixes=mixes)
+    fleet = stress.sweep_fleet(n_seeds=2, verbose=False, mixes=mixes)
+    assert host["ok"] and fleet["ok"]
+    assert host["runs"] == fleet["runs"] == 4
+
+
+@pytest.mark.slow
 def test_stress_sweep_episode_mixes_smoke(monkeypatch):
     """The correlated-fault mixes (partition-flap / one-way /
     pause-heavy / pause-crash), two seeds each — the `make

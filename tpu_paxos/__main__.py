@@ -485,7 +485,28 @@ def run_repro(argv) -> int:
     # replay surface: log stamps must not re-introduce wall clock into
     # anything a byte-compare might capture (utils/log.deterministic_mode)
     os.environ.setdefault("TPU_PAXOS_DETERMINISTIC", "1")
-    _select_backend(args.backend)
+    # Peek the artifact header BEFORE backend init: a sharded artifact
+    # records the device count its decision log was produced at, and
+    # the mesh must be provisioned up front (virtual CPU devices
+    # cannot be added after the backend initializes).  Unreadable /
+    # malformed artifacts fall through — load_artifact produces the
+    # clean exit-2 schema error below.
+    devices = 1
+    try:
+        with open(args.artifact) as f:
+            hdr = json.load(f)
+        if isinstance(hdr, dict) and hdr.get("engine") == "sharded":
+            devices = int(hdr.get("devices", 1))
+    except (OSError, ValueError, TypeError):
+        # TypeError: a non-numeric "devices" (null/list) — like the
+        # other malformed shapes, it falls through to load_artifact's
+        # exit-2 schema error naming the field
+        devices = 1
+    if devices > 1:
+        backend = "cpu" if args.backend == "auto" else args.backend
+        _select_backend(backend, mesh=devices)
+    else:
+        _select_backend(args.backend)
     from tpu_paxos.harness import shrink as shr
     from tpu_paxos.utils import log as logm
 
@@ -528,6 +549,12 @@ def main(argv=None) -> int:
         # subcommand form: the positional grammar below is the
         # reference CLI's (srvcnt cltcnt idcnt); repro takes a path
         return run_repro(argv[1:])
+    if argv and argv[0] == "fleet":
+        # device-batched schedule search: (seed x schedule) lanes per
+        # XLA dispatch, wedges shrunk to repro artifacts
+        from tpu_paxos.fleet import search as fsearch
+
+        return fsearch.main(argv[1:])
     if argv and argv[0] == "lint":
         # static analysis: pure-AST, deliberately runs without jax
         from tpu_paxos.analysis import lint as lintm

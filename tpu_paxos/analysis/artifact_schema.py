@@ -211,6 +211,11 @@ _CFG = Obj({
 
 ARTIFACT_SCHEMA = Obj({
     "format": Const(ARTIFACT_FORMAT),
+    # replay engine selector (optional; absent = "sim").  "sharded"
+    # artifacts also record the device count their decision log was
+    # produced at — placement, hence the log, depends on it.
+    "engine": OneOf("sim", "sharded"),
+    "devices": Int(min=1),
     "cfg": _CFG,
     "workload": ListOf(ListOf(Int())),
     "gates": Nullable(ListOf(ListOf(Int()))),

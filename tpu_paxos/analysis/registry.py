@@ -36,6 +36,7 @@ AUDIT_PROVIDERS = (
     "tpu_paxos.membership.engine",
     "tpu_paxos.parallel.sharded",
     "tpu_paxos.parallel.sharded_sim",
+    "tpu_paxos.fleet.runner",
 )
 
 

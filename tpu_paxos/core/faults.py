@@ -204,6 +204,53 @@ class CompiledSchedule(NamedTuple):
     has_burst: bool
 
 
+def validate_episode(e: Episode, n_nodes: int) -> None:
+    """Cluster-size checks shared by both schedule lowerings (the
+    compile-time tables below and the fleet's runtime encoding,
+    fleet/schedule_table.py)."""
+    if e._max_node() >= n_nodes:
+        raise ValueError(
+            f"episode {e.kind}[{e.t0},{e.t1}) names node "
+            f"{e._max_node()} but the cluster has {n_nodes} nodes"
+        )
+    if e.kind == "partition":
+        # a single group needs unlisted nodes to form the implicit
+        # complement, or the 'partition' cuts nothing
+        listed = sum(len(g) for g in e.groups)
+        if len(e.groups) < 2 and listed >= n_nodes:
+            raise ValueError(
+                f"partition[{e.t0},{e.t1}) lists every node in one "
+                "group — nothing is cut; name >= 2 groups or leave "
+                "nodes unlisted to form the implicit complement"
+            )
+
+
+def episode_tables(e: Episode, n_nodes: int):
+    """Static per-episode masks — the single source of truth both
+    lowerings share: ``(cut [N, N] bool, paused [N] bool, extra_drop
+    int)`` where ``cut[s, d]`` means the s->d edge is severed while the
+    episode is active.  The diagonal is never cut (a node always
+    reaches itself).  Only the episode's own dimension is non-trivial;
+    the other two return zeros."""
+    validate_episode(e, n_nodes)
+    cut = np.zeros((n_nodes, n_nodes), bool)
+    paused = np.zeros((n_nodes,), bool)
+    extra = 0
+    if e.kind == "partition":
+        group_of = np.full((n_nodes,), len(e.groups), np.int32)
+        for gi, g in enumerate(e.groups):
+            group_of[list(g)] = gi
+        cut = group_of[:, None] != group_of[None, :]
+    elif e.kind == "one_way":
+        cut[np.ix_(list(e.src), list(e.dst))] = True
+        np.fill_diagonal(cut, False)
+    elif e.kind == "pause":
+        paused[list(e.nodes)] = True
+    elif e.kind == "burst":
+        extra = e.drop_rate
+    return cut, paused, extra
+
+
 def compile_schedule(
     sched: FaultSchedule | None, n_nodes: int
 ) -> CompiledSchedule | None:
@@ -212,42 +259,16 @@ def compile_schedule(
     with zero overhead)."""
     if sched is None or not sched.episodes:
         return None
-    for e in sched.episodes:
-        if e._max_node() >= n_nodes:
-            raise ValueError(
-                f"episode {e.kind}[{e.t0},{e.t1}) names node "
-                f"{e._max_node()} but the cluster has {n_nodes} nodes"
-            )
-        if e.kind == "partition":
-            # a single group needs unlisted nodes to form the implicit
-            # complement, or the 'partition' cuts nothing
-            listed = sum(len(g) for g in e.groups)
-            if len(e.groups) < 2 and listed >= n_nodes:
-                raise ValueError(
-                    f"partition[{e.t0},{e.t1}) lists every node in one "
-                    "group — nothing is cut; name >= 2 groups or leave "
-                    "nodes unlisted to form the implicit complement"
-                )
     h = sched.horizon
     reach = np.ones((h + 1, n_nodes, n_nodes), bool)
     paused = np.zeros((h + 1, n_nodes), bool)
     extra = np.zeros((h + 1,), np.int64)
     for e in sched.episodes:
         rows = slice(e.t0, e.t1)  # t1 <= h, so row h stays healed
-        if e.kind == "partition":
-            group_of = np.full((n_nodes,), len(e.groups), np.int32)
-            for gi, g in enumerate(e.groups):
-                group_of[list(g)] = gi
-            same = group_of[:, None] == group_of[None, :]
-            reach[rows] &= same[None]
-        elif e.kind == "one_way":
-            cut = np.zeros((n_nodes, n_nodes), bool)
-            cut[np.ix_(list(e.src), list(e.dst))] = True
-            reach[rows] &= ~cut[None]
-        elif e.kind == "pause":
-            paused[rows, list(e.nodes)] = True
-        elif e.kind == "burst":
-            extra[rows] += e.drop_rate
+        cut, pmask, xd = episode_tables(e, n_nodes)
+        reach[rows] &= ~cut[None]
+        paused[rows] |= pmask[None]
+        extra[rows] += xd
     np.einsum("tnn->tn", reach)[:] = True  # a node always reaches itself
     return CompiledSchedule(
         reach=reach,

@@ -385,10 +385,24 @@ def build_engine(
     n_shards: int = 1,
     vid_cap: int = 0,
     use_pallas: bool | None = None,
+    runtime_schedule: bool = False,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
     the state; everything shape-like is baked in.
+
+    With ``runtime_schedule=True`` the correlated-fault schedule is NOT
+    baked in: ``round_fn(root, state, tab)`` takes a traced
+    ``fleet.schedule_table.ScheduleTable`` and computes the per-round
+    reach/pause/drop masks inside the step (``masks_at``), so ONE
+    compiled executable covers every episode mix of the table's
+    ``(max_episodes, n_nodes)`` envelope — the fleet runner vmaps this
+    over a lane axis of tables.  ``cfg.faults.schedule`` must be None
+    in this mode (the schedule arrives per call); the single-run
+    constant path below stays the default and the two are
+    decision-log-identical for the same schedule (the mask values and
+    the PRNG streams are equal round for round — parity pinned by
+    tests/test_fleet.py).
 
     With ``axis_name`` set (one mesh axis name, or a tuple of names
     for the 2-D dcn x ici multi-host mesh — ``lax`` collectives and
@@ -415,6 +429,13 @@ def build_engine(
         raise ValueError(f"n_instances {i_cap} not divisible by {n_shards}")
     i_loc = i_cap // n_shards  # instances per shard ([I]-axis array size)
     max_crash = (a - 1) // 2
+    if runtime_schedule and fc.schedule is not None:
+        raise ValueError(
+            "runtime_schedule engines take their schedule per call "
+            "(ScheduleTable); cfg.faults.schedule must be None"
+        )
+    if runtime_schedule:
+        from tpu_paxos.fleet import schedule_table as _stm
     # Correlated-fault schedule, lowered to dense per-round tables and
     # baked in as compile-time constants (replicated under shard_map —
     # every shard indexes identical tables with the replicated round
@@ -487,7 +508,12 @@ def build_engine(
     def rany(b):
         return jnp.any(b)
 
-    def round_fn(root: jax.Array, st: SimState) -> SimState:
+    def round_fn(root: jax.Array, st: SimState, tab=None) -> SimState:
+        if runtime_schedule and tab is None:
+            raise TypeError(
+                "this engine was built with runtime_schedule=True; "
+                "round_fn needs a ScheduleTable argument"
+            )
         # queue rows must be pre-padded by the window width (see
         # prepare_queues) so window ops are copy-free dynamic slices.
         # ValueError, not assert: this is trace-time-only (zero runtime
@@ -514,13 +540,26 @@ def build_engine(
         ar = jax.tree.map(lambda b: b[slot], st.net)
         net = netm.clear_slot(st.net, slot)
 
-        # Fault-schedule tables for this round (min(t, horizon): row
-        # `horizon` is the healed steady state, so post-schedule
-        # rounds read all-clear masks at no branch cost).
-        tt = jnp.minimum(t, jnp.int32(horizon)) if comp is not None else None
-        paused_t = pause_tab[tt] if pause_tab is not None else None  # [A]
-        reach_t = reach_tab[tt] if reach_tab is not None else None  # [N, N]
-        xdrop_t = drop_tab[tt] if drop_tab is not None else None  # int32
+        if runtime_schedule:
+            # Per-round masks computed from the traced per-lane table
+            # (fleet/schedule_table.masks_at) — same composition
+            # semantics as the constant rows below, so the two paths
+            # are decision-log-identical for the same schedule.  All
+            # three dimensions are live (the table's content, not its
+            # shape, says which episodes exist).
+            reach_t, paused_t, xdrop_t = _stm.masks_at(tab, t)
+        else:
+            # Fault-schedule tables for this round (min(t, horizon):
+            # row `horizon` is the healed steady state, so
+            # post-schedule rounds read all-clear masks at no branch
+            # cost).
+            tt = (
+                jnp.minimum(t, jnp.int32(horizon)) if comp is not None
+                else None
+            )
+            paused_t = pause_tab[tt] if pause_tab is not None else None  # [A]
+            reach_t = reach_tab[tt] if reach_tab is not None else None
+            xdrop_t = drop_tab[tt] if drop_tab is not None else None  # int32
 
         # I/O-alive mask: crashed OR currently paused nodes neither
         # send, receive, nor act on timers this round.  Excusals
@@ -1508,7 +1547,13 @@ def build_engine(
         contiguous = n_chosen == hmax + 1
         learned_ok = jnp.all((n_learned == hmax + 1) | crashed)
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
-        if horizon:
+        if runtime_schedule:
+            # Heal-then-converge with a TRACED horizon: the per-lane
+            # table carries its own last-heal round; past it the
+            # comparison is vacuous, so schedule-free lanes lose
+            # nothing.
+            done = done & (t >= jnp.asarray(tab.horizon, jnp.int32))
+        elif horizon:
             # Heal-then-converge contract: quiescence is never declared
             # before the last episode ends — a paused node's catch-up
             # (and a partitioned minority's repair) is owed, not
@@ -1776,12 +1821,43 @@ def audit_entries():
         state = init_state(cfg, pend, gate, tail, root)
         return _run_loop(cfg, build_engine(cfg, c, vid_cap=0)), (root, state)
 
-    return [AuditEntry(
-        "sim.run_rounds", build, covers=("_run_loop",),
-        allow=("IR204",),
-        why="conflict-requeue compaction sorts on provably-unique keys "
-            "(global instance ids / window offsets); instability cannot "
-            "reorder equal keys because there are none, and a stable "
-            "sort would pay for a third, hidden iota operand — see the "
-            "comment at the _sort_narrow/_sort_full sites",
-    )]
+    def build_episodes():
+        # Episode-schedule-bearing config: the compile-time schedule
+        # tables (reach/pause/drop rows) are baked into the traced
+        # program as CONSTANTS — this is the const-table path IR205's
+        # const budget was written to watch (an accidentally-huge
+        # horizon or node count shows up as const bloat here).
+        sched = fltm.FaultSchedule((
+            fltm.partition(2, 10, (0,), (1, 2)),
+            fltm.one_way(4, 14, (1,), (2,)),
+            fltm.pause(6, 12, 2),
+            fltm.burst(3, 9, 1500),
+        ))
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000,
+                               schedule=sched),
+        )
+        workload = default_workload(cfg)
+        pend, gate, tail, c = prepare_queues(cfg, workload, None)
+        root = prng.root_key(cfg.seed)
+        state = init_state(cfg, pend, gate, tail, root)
+        return _run_loop(cfg, build_engine(cfg, c, vid_cap=0)), (root, state)
+
+    ir204_why = (
+        "conflict-requeue compaction sorts on provably-unique keys "
+        "(global instance ids / window offsets); instability cannot "
+        "reorder equal keys because there are none, and a stable "
+        "sort would pay for a third, hidden iota operand — see the "
+        "comment at the _sort_narrow/_sort_full sites"
+    )
+    return [
+        AuditEntry(
+            "sim.run_rounds", build, covers=("_run_loop",),
+            allow=("IR204",), why=ir204_why,
+        ),
+        AuditEntry(
+            "sim.run_rounds_episodes", build_episodes,
+            allow=("IR204",), why=ir204_why,
+        ),
+    ]

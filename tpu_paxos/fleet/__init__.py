@@ -1,0 +1,18 @@
+"""Device-batched simulation fleets: hundreds of (seed x schedule)
+lanes of the general engine per XLA dispatch, judged on device.
+
+Submodules are lazily re-exported (PEP 562), mirroring ``core``:
+``schedule_table`` is imported by ``core.sim`` when an engine is built
+with runtime schedules, and that must not eagerly drag in the runner /
+search stack (which imports the harness).
+"""
+
+_SUBMODULES = ("runner", "schedule_table", "search", "verdict")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"tpu_paxos.fleet.{name}")
+    raise AttributeError(f"module 'tpu_paxos.fleet' has no attribute {name!r}")

@@ -1,0 +1,127 @@
+"""Runtime fault-schedule encoding: episodes as dense device arrays.
+
+``core/faults.compile_schedule`` lowers a ``FaultSchedule`` to
+per-round tables baked into the engine closure as COMPILE-TIME
+constants — the right trade for a single run (mask gathers cost one
+row index, schedule-free dimensions elide entirely), but fatal for a
+fleet: every distinct episode mix would be its own XLA program, and a
+randomized schedule search would compile per candidate.
+
+This module is the runtime twin: a schedule becomes a
+:class:`ScheduleTable` of per-EPISODE arrays — interval bounds
+``t0``/``t1`` plus the episode's static masks from
+``faults.episode_tables`` (cut edges, paused nodes, burst rate) —
+padded to a fixed episode capacity, and the per-round reach / pause /
+drop masks are computed INSIDE the traced step (:func:`masks_at`):
+
+    active[e] = t0[e] <= t < t1[e]
+    reach     = ~any_e(active[e] & cut[e])        (diagonal never cut)
+    paused    =  any_e(active[e] & paused[e])
+    extra     =  min(sum_e(active[e] * drop[e]), 10000)
+
+Episode composition therefore matches the compile-time lowering
+exactly — cuts AND their reachability, pauses OR, burst rates add —
+and the parity is pinned per round by tests/test_schedule_table.py
+(table-encoded masks == compiled table rows for every episode kind)
+and end-to-end by the fleet's lane-by-lane decision-log sha256 test.
+
+Tables are plain data (numpy on host, jnp once traced), stack along a
+leading lane axis (:func:`encode_batch`), and make one compiled
+executable cover EVERY episode mix of a given ``(max_episodes,
+n_nodes)`` envelope — the fleet's lane axis vmaps over them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from tpu_paxos.core import faults as fltm
+
+
+class ScheduleTable(NamedTuple):
+    """One lane's schedule as dense runtime arrays (host: numpy;
+    traced: jnp with an optional leading lane axis).  Padding slots
+    hold ``t0 == t1 == 0`` — never active — so any schedule with at
+    most ``E`` episodes fits the same shapes."""
+
+    t0: np.ndarray  # [E] int32 episode starts
+    t1: np.ndarray  # [E] int32 episode ends (t1 <= t0 = never active)
+    cut: np.ndarray  # [E, N, N] bool edges severed while active
+    paused: np.ndarray  # [E, N] bool nodes paused while active
+    extra_drop: np.ndarray  # [E] int32 per-1e4 burst addition
+    horizon: np.ndarray  # [] int32 first round with every episode over
+
+
+def encode_schedule(
+    sched: fltm.FaultSchedule | None,
+    n_nodes: int,
+    max_episodes: int | None = None,
+) -> ScheduleTable:
+    """Encode one schedule (None/empty = the all-clear table: masks
+    read healed at every round and ``horizon`` is 0, so the engine's
+    heal gate never delays quiescence)."""
+    eps = () if sched is None else sched.episodes
+    e_cap = len(eps) if max_episodes is None else max_episodes
+    e_cap = max(e_cap, 1)  # zero-length episode axes break vmap stacking
+    if len(eps) > e_cap:
+        raise ValueError(
+            f"schedule has {len(eps)} episodes; table capacity is {e_cap}"
+        )
+    t0 = np.zeros((e_cap,), np.int32)
+    t1 = np.zeros((e_cap,), np.int32)
+    cut = np.zeros((e_cap, n_nodes, n_nodes), bool)
+    paused = np.zeros((e_cap, n_nodes), bool)
+    extra = np.zeros((e_cap,), np.int32)
+    for i, e in enumerate(eps):
+        c, p, x = fltm.episode_tables(e, n_nodes)
+        t0[i], t1[i] = e.t0, e.t1
+        cut[i], paused[i], extra[i] = c, p, x
+    return ScheduleTable(
+        t0=t0,
+        t1=t1,
+        cut=cut,
+        paused=paused,
+        extra_drop=extra,
+        horizon=np.int32(sched.horizon if sched is not None else 0),
+    )
+
+
+def encode_batch(
+    schedules,
+    n_nodes: int,
+    max_episodes: int | None = None,
+) -> ScheduleTable:
+    """Stack one table per lane along a leading lane axis.  All lanes
+    share one episode capacity (the max over lanes unless given), so
+    the batch vmaps as a single pytree."""
+    schedules = list(schedules)
+    if not schedules:
+        raise ValueError("encode_batch needs at least one lane")
+    if max_episodes is None:
+        max_episodes = max(
+            len(s.episodes) if s is not None else 0 for s in schedules
+        )
+    tabs = [encode_schedule(s, n_nodes, max_episodes) for s in schedules]
+    return ScheduleTable(
+        *(np.stack([getattr(t, f) for t in tabs]) for f in ScheduleTable._fields)
+    )
+
+
+def masks_at(tab: ScheduleTable, t):
+    """Per-round masks from a (traced) table: ``(reach [N, N] bool,
+    paused [N] bool, extra_drop int32)``.  Pure jnp — called inside
+    the engine's round function; composition semantics match
+    ``faults.compile_schedule`` row ``t`` exactly (module doc)."""
+    import jax.numpy as jnp
+
+    t = jnp.asarray(t, jnp.int32)
+    active = (tab.t0 <= t) & (t < tab.t1)  # [E]
+    reach = ~jnp.any(active[:, None, None] & tab.cut, axis=0)  # [N, N]
+    paused = jnp.any(active[:, None] & tab.paused, axis=0)  # [N]
+    extra = jnp.minimum(
+        jnp.sum(jnp.where(active, tab.extra_drop, jnp.int32(0))),
+        jnp.int32(10_000),
+    )
+    return reach, paused, extra

@@ -1,0 +1,313 @@
+"""Randomized schedule search: generate episode schedules from a
+seeded grammar, run them as fleet lanes, shrink every wedge found.
+
+The ROADMAP's fault-schedule follow-on asks for randomized schedule
+*generation* — searching for minimal wedging schedules instead of
+replaying the four hand-written stress mixes.  This module is that
+searcher, built on the fleet runner so candidate schedules cost lanes
+(one XLA dispatch per generation), not compiles:
+
+1. per lane, sample a schedule from the seeded grammar
+   (:func:`sample_schedule`: partition / one-way / pause / burst with
+   jittered intervals, random groups, and random burst rates) and a
+   fresh engine seed;
+2. run the whole generation as one fleet dispatch; the on-device
+   verdict subset plus the optional ``decision_round_max`` bound (the
+   artifact-recorded extra check the triage stack already judges)
+   flag suspicious lanes;
+3. every flagged lane is re-run through the single-run engine — the
+   fleet's lane-for-lane decision-log parity makes this a pure
+   re-derivation — judged by the FULL invariant suite, greedily
+   shrunk (``harness/shrink.py``), and written as a one-command repro
+   artifact that ``python -m tpu_paxos repro`` replays
+   byte-identically;
+4. iterate generations until the budget runs out.
+
+``python -m tpu_paxos fleet`` (or ``make fleet`` / ``make
+fleet-quick``) prints ONE JSON summary line — lanes/sec, wedges
+found, artifact paths — and exits non-zero only when a REAL invariant
+violation was found (a ``decision_round_max`` bound is a synthetic
+wedge knob: useful for exercising the triage path and for
+convergence-latency hunting, but not a correctness failure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as fltm
+
+KINDS = ("partition", "one_way", "pause", "burst")
+
+
+def sample_episode(
+    rng: np.random.Generator, n_nodes: int, horizon: int
+) -> fltm.Episode:
+    """One grammar draw: a kind, a jittered interval inside
+    ``[0, horizon)``, and kind-specific random structure (groups /
+    directions / pause sets / burst rates)."""
+    kind = KINDS[int(rng.integers(len(KINDS)))]
+    t0 = int(rng.integers(0, max(1, horizon - 6)))
+    width = int(rng.integers(4, max(5, horizon // 2)))
+    t1 = min(t0 + width, horizon)
+    if t1 <= t0:
+        t1 = t0 + 1
+    if kind == "partition":
+        nodes = rng.permutation(n_nodes)
+        k = int(rng.integers(1, n_nodes))  # both sides non-empty
+        return fltm.partition(
+            t0, t1, tuple(int(x) for x in nodes[:k]),
+            tuple(int(x) for x in nodes[k:]),
+        )
+    if kind == "one_way":
+        nodes = rng.permutation(n_nodes)
+        ns = int(rng.integers(1, n_nodes))
+        nd = int(rng.integers(1, n_nodes))
+        src = tuple(int(x) for x in nodes[:ns])
+        dst = tuple(int(x) for x in rng.permutation(n_nodes)[:nd])
+        return fltm.one_way(t0, t1, src, dst)
+    if kind == "pause":
+        n_paused = int(rng.integers(1, max(2, n_nodes // 2 + 1)))
+        nodes = rng.permutation(n_nodes)[:n_paused]
+        return fltm.pause(t0, t1, *(int(x) for x in nodes))
+    return fltm.burst(t0, t1, int(rng.integers(500, 6000)))
+
+
+def sample_schedule(
+    rng: np.random.Generator,
+    n_nodes: int,
+    max_episodes: int = 4,
+    horizon: int = 96,
+) -> fltm.FaultSchedule:
+    n_eps = int(rng.integers(1, max_episodes + 1))
+    return fltm.FaultSchedule(tuple(
+        sample_episode(rng, n_nodes, horizon) for _ in range(n_eps)
+    ))
+
+
+def search(
+    n_lanes: int,
+    generations: int,
+    base_seed: int = 0,
+    triage_dir: str | None = None,
+    decision_round_max: int | None = None,
+    n_nodes: int = 5,
+    n_prop: int = 2,
+    fault_kw: dict | None = None,
+    max_episodes: int = 4,
+    horizon: int = 96,
+    max_wedges: int = 8,
+    mesh=None,
+    verbose: bool = True,
+) -> dict:
+    """Run the generation loop; returns the JSON-ready summary."""
+    from tpu_paxos.fleet import runner as frun
+    from tpu_paxos.harness import shrink as shr
+    from tpu_paxos.harness import stress as strs
+    from tpu_paxos.utils import log as logm
+
+    logger = logm.get_logger(
+        "fleet", logm.parse_level("INFO" if verbose else "WARN")
+    )
+    fault_kw = dict(fault_kw or dict(drop_rate=300, dup_rate=500, max_delay=2))
+    wl_rng = np.random.default_rng(base_seed)
+    workload, gates, chains = strs._workload(n_prop, wl_rng)
+    cfg = SimConfig(
+        n_nodes=n_nodes,
+        n_instances=2 * sum(len(w) for w in workload),
+        proposers=tuple(range(n_prop)),
+        seed=base_seed,
+        max_rounds=20_000,
+        faults=FaultConfig(**fault_kw),
+    )
+    runner = frun.FleetRunner(
+        cfg, workload, gates, mesh=mesh, max_episodes=max_episodes
+    )
+    extra = (
+        {"decision_round_max": int(decision_round_max)}
+        if decision_round_max else {}
+    )
+    t0 = time.perf_counter()
+    lanes_total = 0
+    wedges: list[dict] = []
+    anomalies: list[dict] = []
+    for g in range(generations):
+        sched_rng = np.random.default_rng((base_seed, g))
+        schedules = [
+            sample_schedule(sched_rng, n_nodes, max_episodes, horizon)
+            for _ in range(n_lanes)
+        ]
+        seeds = [base_seed + g * n_lanes + i for i in range(n_lanes)]
+        rep = runner.run(seeds, schedules)
+        lanes_total += n_lanes
+        real_flagged = set(rep.failing)
+        flagged = set(real_flagged)
+        if decision_round_max is not None:
+            flagged |= {
+                i for i in range(n_lanes)
+                if int(rep.verdict.max_round[i]) > decision_round_max
+            }
+        logger.info(
+            "generation %d: %d lanes, %d flagged (%.1f lanes/sec)",
+            g, n_lanes, len(flagged), rep.lanes_per_sec,
+        )
+        for i in sorted(flagged):
+            if len(wedges) >= max_wedges:
+                break
+            # The synthetic decision_round_max check is attached ONLY
+            # to lanes flagged by it alone: a lane red on the REAL
+            # verdict must shrink against real invariants — with the
+            # synthetic bound in its case, the greedy shrinker (which
+            # accepts ANY still-failing candidate) could trade the
+            # real violation for a harmless latency wedge and lose
+            # the actual bug's minimal repro.
+            case = shr.ReproCase(
+                cfg=rep.lane_cfg(i), workload=workload, gates=gates,
+                chains=chains,
+                extra_checks={} if i in real_flagged else dict(extra),
+            )
+            _, viol = shr.run_case(case)
+            if viol is None:
+                # the on-device subset flagged a lane the full suite
+                # clears — surface it, never hide it (a parity break
+                # would show up exactly here)
+                anomalies.append({
+                    "generation": g, "lane": i, "seed": rep.seeds[i],
+                    "verdict": {
+                        f: bool(getattr(rep.verdict, f)[i])
+                        for f in ("ok", "agreement", "coverage", "quiescent")
+                    },
+                })
+                continue
+            wedge = {
+                "generation": g,
+                "lane": i,
+                "seed": rep.seeds[i],
+                "violation": viol[:300],
+                "synthetic": "decision_round_max" in (viol or ""),
+                "schedule": rep.schedules[i].to_dict(),
+            }
+            if triage_dir:
+                os.makedirs(triage_dir, exist_ok=True)
+                path = os.path.join(
+                    triage_dir, f"repro_fleet_g{g}_lane{i}.json"
+                )
+                try:
+                    shr.triage(case, path, logger=logger)
+                    wedge["artifact"] = path
+                    logger.info("wedge shrunk -> %s", path)
+                except Exception as te:  # triage must never mask a find
+                    wedge["triage_error"] = str(te)[:300]
+            wedges.append(wedge)
+        if len(wedges) >= max_wedges:
+            logger.info("wedge budget (%d) reached", max_wedges)
+            break
+    seconds = time.perf_counter() - t0
+    real = [w for w in wedges if not w["synthetic"]]
+    return {
+        "metric": "fleet_search",
+        "lanes": n_lanes,
+        "generations": generations,
+        "lanes_total": lanes_total,
+        "lanes_per_sec": round(lanes_total / max(seconds, 1e-9), 2),
+        "seconds": round(seconds, 1),
+        "wedges_found": len(wedges),
+        "real_violations": len(real),
+        "wedges": wedges,
+        "anomalies": anomalies,
+        "ok": not real and not anomalies,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_paxos fleet",
+        description="device-batched schedule search: sample episode "
+        "schedules per lane, run them as one fleet dispatch per "
+        "generation, shrink every wedge to a repro artifact",
+    )
+    ap.add_argument("--lanes", type=int, default=0,
+                    help="lanes per generation (0 = backend default)")
+    ap.add_argument("--generations", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--proposers", type=int, default=2)
+    ap.add_argument("--max-episodes", type=int, default=4)
+    ap.add_argument("--horizon", type=int, default=96,
+                    help="grammar bound: every sampled episode ends "
+                    "by this round")
+    ap.add_argument("--max-wedges", type=int, default=8)
+    ap.add_argument("--decision-round-max", type=int, default=0,
+                    help="flag lanes whose latest decision lands "
+                    "after this round (synthetic wedge knob; 0 = off)")
+    ap.add_argument("--drop-rate", type=int, default=300)
+    ap.add_argument("--dup-rate", type=int, default=500)
+    ap.add_argument("--max-delay", type=int, default=2)
+    ap.add_argument("--crash-rate", type=int, default=0)
+    ap.add_argument("--triage-dir", type=str, default="",
+                    help="shrink every wedge into a repro artifact "
+                    "here (replay: python -m tpu_paxos repro <path>)")
+    ap.add_argument("--backend", choices=("tpu", "cpu", "auto"),
+                    default="auto")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="tile the lane axis over this many devices "
+                    "(shard_map; lanes must divide evenly; with "
+                    "--backend cpu, virtual devices are provisioned)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    # same backend/provisioning path as the repro CLI: a --mesh
+    # request coerces auto -> cpu so virtual devices actually get
+    # provisioned, and a short mesh fails loudly — silently running
+    # unmeshed would let the user believe the tile was exercised
+    from tpu_paxos.__main__ import _select_backend
+
+    mesh = None
+    if args.mesh:
+        backend = "cpu" if args.backend == "auto" else args.backend
+        _select_backend(backend, args.mesh)
+        from tpu_paxos.parallel import mesh as pmesh
+
+        mesh = pmesh.make_instance_mesh(args.mesh)
+        if mesh.size != args.mesh:
+            raise SystemExit(
+                f"--mesh {args.mesh} requested but only {mesh.size} "
+                "device(s) came up (use --backend cpu for virtual "
+                "provisioning)"
+            )
+    else:
+        _select_backend(args.backend)
+    from tpu_paxos.fleet import runner as frun
+    n_lanes = args.lanes or frun.default_lane_count()
+    if mesh is not None:
+        n_lanes += (-n_lanes) % mesh.size  # lanes must tile the mesh
+    summary = search(
+        n_lanes=n_lanes,
+        generations=args.generations,
+        base_seed=args.seed,
+        triage_dir=args.triage_dir or None,
+        decision_round_max=args.decision_round_max or None,
+        n_nodes=args.nodes,
+        n_prop=args.proposers,
+        fault_kw=dict(
+            drop_rate=args.drop_rate, dup_rate=args.dup_rate,
+            max_delay=args.max_delay, crash_rate=args.crash_rate,
+        ),
+        max_episodes=args.max_episodes,
+        horizon=args.horizon,
+        max_wedges=args.max_wedges,
+        mesh=mesh,
+        verbose=not args.quiet,
+    )
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""On-device per-lane verdicts: the invariant subset that decides
+which lanes pay host transfer.
+
+The full invariant suite (``harness/validate``) is host-side numpy
+over the whole learned matrix — fine for one run, ruinous for a fleet
+(hundreds of lanes would serialize through the device tunnel).  The
+fleet instead reduces a SUBSET of the invariants to one boolean per
+lane INSIDE the fleet dispatch, so only failing lanes are ever
+transferred and re-judged by the full suite (and then shrunk,
+``harness/shrink.py``):
+
+- **agreement** — no two nodes learned different values for the same
+  instance (the core safety property; exact, not a subset);
+- **chosen-coverage** — every workload value whose proposer survived
+  was chosen (the crash-aware liveness rule of
+  ``shrink.validate_run``: a crashed proposer's undrained queue is
+  legitimately lost, a paused/partitioned one's is owed);
+- **quiescence-by-budget** — the engine's ``done`` predicate held
+  within the round budget, excused only when every proposer crashed
+  (mirrors ``shrink.check_run``).
+
+What the subset does NOT re-check on device: exactly-once (subsumed
+for fleet workloads — coverage counts distinct chosen cells against
+distinct workload vids, and a double-chosen value would leave some
+other value uncovered), executed-identical and in-order clients
+(host-side sequence properties).  A lane can therefore pass the
+device verdict and still fail the full suite in principle; the fleet
+trades that tail for not transferring the 99% of green lanes, and the
+stress sweep's ``--fleet`` mode documents the same contract.  The
+``max_round`` output feeds the search's ``decision_round_max`` wedge
+knob (the artifact-recorded extra check) host-side.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.config import SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.core import values as val
+
+
+class LaneVerdict(NamedTuple):
+    """Per-lane verdict vector(s); scalar per lane unbatched, [L]
+    under the fleet vmap."""
+
+    ok: jnp.ndarray  # every subset invariant green
+    agreement: jnp.ndarray
+    coverage: jnp.ndarray
+    quiescent: jnp.ndarray
+    rounds: jnp.ndarray  # int32 rounds simulated
+    max_round: jnp.ndarray  # int32 latest decision round (-1: none)
+
+
+def expected_owners(
+    cfg: SimConfig, workload: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
+    """``(expected [V] int32, owner_node [V] int32)``: the distinct
+    workload vids and, per vid, the NODE of the proposer that queues
+    it (the crash-excusal key).  Shared by every lane of a fleet —
+    the runner asserts per-lane workloads agree on this set."""
+    vids, owners = [], []
+    for pi, w in enumerate(workload):
+        node = cfg.proposers[pi]
+        for v in np.asarray(w, np.int32).reshape(-1):
+            vids.append(int(v))
+            owners.append(node)
+    order = np.argsort(vids, kind="stable")
+    vids = np.asarray(vids, np.int32)[order]
+    owners = np.asarray(owners, np.int32)[order]
+    uniq, first = np.unique(vids, return_index=True)
+    return uniq.astype(np.int32), owners[first].astype(np.int32)
+
+
+def lane_verdict(
+    cfg: SimConfig,
+    final: simm.SimState,
+    expected: np.ndarray,
+    owner_node: np.ndarray,
+) -> LaneVerdict:
+    """Judge one (unbatched) final engine state on device — the fleet
+    runner vmaps this over the lane axis inside the same jit as the
+    round loop, so the verdict costs no extra dispatch."""
+    learned = final.learned  # [A, I]
+    known = learned != val.NONE
+    # agreement: every knowing node matches the max over knowing nodes
+    best = jnp.max(jnp.where(known, learned, jnp.iinfo(jnp.int32).min), axis=0)
+    agreement = ~jnp.any(known & (learned != best[None]))
+
+    # coverage via a chosen-membership bitmap (expected vids are a
+    # static host array, so vid_cap is a static bound)
+    chosen = final.met.chosen_vid  # [I]
+    vid_cap = int(expected.max()) + 1 if expected.size else 1
+    bitmap = jnp.zeros((vid_cap,), jnp.bool_).at[
+        jnp.where(chosen >= 0, chosen, vid_cap)
+    ].set(True, mode="drop")
+    exp = jnp.asarray(expected, jnp.int32)
+    own = jnp.asarray(owner_node, jnp.int32)
+    owner_crashed = final.crashed[own]  # [V]
+    coverage = jnp.all(bitmap[exp] | owner_crashed)
+
+    pn = jnp.asarray(cfg.proposers, jnp.int32)
+    all_props_crashed = jnp.all(final.crashed[pn])
+    quiescent = final.done | all_props_crashed
+
+    max_round = jnp.max(
+        jnp.where(chosen != val.NONE, final.met.chosen_round, -1)
+    )
+    ok = agreement & coverage & quiescent
+    return LaneVerdict(
+        ok=ok,
+        agreement=agreement,
+        coverage=coverage,
+        quiescent=quiescent,
+        rounds=final.t,
+        max_round=max_round,
+    )

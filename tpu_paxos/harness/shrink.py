@@ -55,13 +55,24 @@ MAX_EVALS = 200
 
 @dataclasses.dataclass
 class ReproCase:
-    """A fully-specified deterministic run plus its judgment criteria."""
+    """A fully-specified deterministic run plus its judgment criteria.
+
+    ``engine`` selects which runner re-executes the case: ``"sim"``
+    (core/sim.run, the default) or ``"sharded"``
+    (parallel/sharded_sim.run_sharded over a ``devices``-wide instance
+    mesh).  The sharded engine's instance PLACEMENT differs from the
+    unsharded one's, so its decision logs only byte-compare against
+    sharded replays at the SAME device count — the artifact records
+    both fields and ``python -m tpu_paxos repro`` provisions the mesh
+    accordingly."""
 
     cfg: SimConfig
     workload: list[np.ndarray]
     gates: list[np.ndarray] | None
     chains: list[np.ndarray]  # in-order client chains (may be empty)
     extra_checks: dict = dataclasses.field(default_factory=dict)
+    engine: str = "sim"
+    devices: int = 1
 
     def with_faults(self, faults: FaultConfig) -> "ReproCase":
         return dataclasses.replace(
@@ -148,7 +159,23 @@ def check_run(r, cfg: SimConfig, workload, chains) -> None:
 
 def run_case(case: ReproCase):
     """Execute the case; returns (SimResult, violation-string-or-None)."""
-    r = simm.run(case.cfg, case.workload, case.gates)
+    if case.engine == "sharded":
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.parallel import sharded_sim
+
+        mesh = pmesh.make_instance_mesh(case.devices)
+        if mesh.size != case.devices:
+            raise RuntimeError(
+                f"sharded repro needs {case.devices} devices; only "
+                f"{mesh.size} visible (provision with --backend cpu, "
+                "which the repro CLI does from the artifact's own "
+                "device count)"
+            )
+        r = sharded_sim.run_sharded(
+            case.cfg, mesh, case.workload, case.gates
+        )
+    else:
+        r = simm.run(case.cfg, case.workload, case.gates)
     try:
         check_run(r, case.cfg, case.workload, case.chains)
         _extra_checks(case, r)
@@ -336,6 +363,8 @@ def save_artifact(path: str, case: ReproCase, violation: str) -> dict:
         )
     art = {
         "format": ARTIFACT_FORMAT,
+        "engine": case.engine,
+        "devices": case.devices,
         "cfg": _cfg_to_dict(case.cfg),
         "workload": [np.asarray(w).tolist() for w in case.workload],
         "gates": (
@@ -387,6 +416,8 @@ def load_artifact(path: str) -> tuple[ReproCase, dict]:
             ),
             chains=[np.asarray(c, np.int32) for c in art["chains"]],
             extra_checks=art.get("extra_checks") or {},
+            engine=art.get("engine", "sim"),
+            devices=art.get("devices", 1),
         )
     except (ValueError, TypeError) as e:
         # semantic constraints the config/episode constructors enforce

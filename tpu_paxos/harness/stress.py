@@ -190,6 +190,7 @@ def sweep(
     base_seed: int = 0,
     verbose: bool = True,
     triage_dir: str | None = None,
+    mixes=None,
 ) -> dict:
     logger = logm.get_logger(
         "stress", logm.parse_level("INFO" if verbose else "WARN")
@@ -198,7 +199,7 @@ def sweep(
     t0 = time.perf_counter()
     from tpu_paxos.utils import prng
 
-    for label, fkw, n_nodes, n_prop in MIXES:
+    for label, fkw, n_nodes, n_prop in (MIXES if mixes is None else mixes):
         go = None  # compiled once per mix; seeds share shapes
         for s in range(n_seeds):
             seed = base_seed + s
@@ -261,10 +262,11 @@ def sweep(
             "mix %-14s: %d seeds done (cumulative %d runs, %d failures)",
             label, n_seeds, runs, len(failures),
         )
+    n_mixes = len(MIXES if mixes is None else mixes)
     return {
         "metric": "stress_sweep",
         "runs": runs,
-        "mixes": len(MIXES),
+        "mixes": n_mixes,
         "seeds_per_mix": n_seeds,
         "failures": failures,
         "ok": not failures,
@@ -272,15 +274,131 @@ def sweep(
     }
 
 
+def sweep_fleet(
+    n_seeds: int = 8,
+    base_seed: int = 0,
+    verbose: bool = True,
+    triage_dir: str | None = None,
+    mixes=None,
+) -> dict:
+    """The episode-mix sweeps through the FLEET runner: per mix, every
+    seed becomes a lane of one device-batched dispatch
+    (fleet/runner.py) — the schedule rides per-lane runtime tables, so
+    a mix compiles once and every seed's whole run happens in a single
+    XLA call.  Lanes are judged on device by the invariant subset
+    (fleet/verdict.py); only failing lanes transfer for the full
+    crash-aware suite + shrink triage.  The host loop (``sweep``)
+    stays the fallback and the single-run default.
+
+    Each lane is decision-log-identical to the host loop's run of the
+    same (mix, seed) — same cfg, workload, and PRNG root — so a lane
+    failure here IS a seed failure there."""
+    from tpu_paxos.fleet import runner as frun
+
+    logger = logm.get_logger(
+        "stress", logm.parse_level("INFO" if verbose else "WARN")
+    )
+    mixes = EPISODE_MIXES if mixes is None else mixes
+    runs, failures = 0, []
+    lane_seconds, lanes_total = 0.0, 0
+    t0 = time.perf_counter()
+    for label, fkw, n_nodes, n_prop in mixes:
+        sched = fkw["schedule"]
+        base_kw = {k: v for k, v in fkw.items() if k != "schedule"}
+        lanes = []  # (seed, workload, gates, chains)
+        for s in range(n_seeds):
+            seed = base_seed + s
+            rng = np.random.default_rng(
+                seed * 7919 + zlib.crc32(label.encode()) % 1000
+            )
+            workload, gates, chains = _workload(n_prop, rng)
+            lanes.append((seed, workload, gates, chains))
+        cfg = SimConfig(
+            n_nodes=n_nodes,
+            n_instances=2 * sum(len(w) for w in lanes[0][1]),
+            proposers=tuple(range(n_prop)),
+            seed=base_seed,
+            max_rounds=20_000,
+            faults=FaultConfig(**base_kw),
+        )
+        runner = frun.FleetRunner(cfg, lanes[0][1], lanes[0][2])
+        rep = runner.run(
+            [ln[0] for ln in lanes],
+            [sched] * n_seeds,
+            workloads=[(ln[1], ln[2]) for ln in lanes],
+        )
+        runs += n_seeds
+        lanes_total += n_seeds
+        lane_seconds += rep.seconds
+        for i in rep.failing:
+            seed, workload, gates, chains = lanes[i]
+            r = rep.lane_result(i)
+            try:
+                _check_run(r, rep.lane_cfg(i), workload, chains)
+                # device verdict flagged a lane the full suite clears:
+                # a parity/verdict bug — report it as its own failure
+                failures.append({
+                    "mix": label, "seed": seed,
+                    "error": "fleet verdict flagged a lane the full "
+                    "suite clears (verdict/parity drift)",
+                })
+                logger.error(
+                    "FLEET ANOMALY mix=%s seed=%d: verdict red, "
+                    "suite green", label, seed,
+                )
+            except validate.InvariantViolation as e:
+                failure = {"mix": label, "seed": seed, "error": str(e)[:300]}
+                logger.error("FAIL mix=%s seed=%d: %s", label, seed, e)
+                if triage_dir:
+                    os.makedirs(triage_dir, exist_ok=True)
+                    path = os.path.join(
+                        triage_dir, f"repro_{label}_{seed}.json"
+                    )
+                    try:
+                        case = shr.ReproCase(
+                            cfg=rep.lane_cfg(i), workload=workload,
+                            gates=gates, chains=chains,
+                        )
+                        shr.triage(case, path, logger=logger)
+                        failure["artifact"] = path
+                        logger.error("repro artifact written to %s", path)
+                    except Exception as te:
+                        failure["triage_error"] = str(te)[:300]
+                failures.append(failure)
+        logger.info(
+            "fleet mix %-14s: %d lanes in %.2fs (%.1f lanes/sec)",
+            label, n_seeds, rep.seconds, rep.lanes_per_sec,
+        )
+    return {
+        "metric": "stress_sweep_fleet",
+        "runs": runs,
+        "mixes": len(mixes),
+        "seeds_per_mix": n_seeds,
+        "lanes": lanes_total,
+        "lanes_per_sec": round(lanes_total / max(lane_seconds, 1e-9), 2),
+        "failures": failures,
+        "ok": not failures,
+        "seconds": round(time.perf_counter() - t0, 1),
+    }
+
+
 def sweep_sharded(
-    n_seeds: int = 2, base_seed: int = 0, verbose: bool = True
+    n_seeds: int = 2, base_seed: int = 0, verbose: bool = True,
+    triage_dir: str | None = None,
 ) -> dict:
     """The debug.conf and crashy mixes PLUS every episode mix through
     the SHARDED engine on the current device mesh (run under a virtual
     multi-device CPU backend via ``--sharded``, which re-execs in a
     clean subprocess).  Chains stay shard-affine via split_workload,
     so the same crash-aware invariant suite applies; episode schedules
-    are compile-time constants replicated across shards."""
+    are compile-time constants replicated across shards.
+
+    With ``triage_dir``, failing seeds are shrunk and written as
+    ``engine="sharded"`` repro artifacts — ``python -m tpu_paxos
+    repro`` replays them through ``parallel/sharded_sim.py`` on a mesh
+    of the recorded device count (sharded placement differs from the
+    unsharded engine's, so the byte-compare only holds engine-for-
+    engine at the same mesh size)."""
     import jax
 
     from tpu_paxos.parallel import mesh as pmesh
@@ -317,10 +435,25 @@ def sweep_sharded(
             try:
                 _check_run(r, cfg, workload, chains)
             except validate.InvariantViolation as e:
-                failures.append(
-                    {"mix": label, "seed": seed, "error": str(e)[:300]}
-                )
+                failure = {"mix": label, "seed": seed, "error": str(e)[:300]}
                 logger.error("FAIL sharded mix=%s seed=%d: %s", label, seed, e)
+                if triage_dir:
+                    os.makedirs(triage_dir, exist_ok=True)
+                    path = os.path.join(
+                        triage_dir, f"repro_sharded_{label}_{seed}.json"
+                    )
+                    try:
+                        case = shr.ReproCase(
+                            cfg=cfg, workload=workload, gates=gates,
+                            chains=chains, engine="sharded",
+                            devices=mesh.size,
+                        )
+                        shr.triage(case, path, logger=logger)
+                        failure["artifact"] = path
+                        logger.error("repro artifact written to %s", path)
+                    except Exception as te:  # triage must never mask
+                        failure["triage_error"] = str(te)[:300]
+                failures.append(failure)
         logger.info("sharded mix %-11s: %d seeds done", label, n_seeds)
     return {
         "metric": "stress_sweep_sharded",
@@ -351,12 +484,33 @@ def main(argv=None) -> int:
         "minimal failing case and write a repro artifact here "
         "(replay with `python -m tpu_paxos repro <artifact>`)",
     )
-    args = ap.parse_args(argv)
-    summary = sweep(
-        args.seeds, args.base_seed, triage_dir=args.triage_dir or None
+    ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="route the episode mixes through the device-batched "
+        "fleet runner (seeds become lanes of one dispatch per mix; "
+        "the host loop keeps the i.i.d.-only mixes and remains the "
+        "fallback)",
     )
-    print(json.dumps(summary))
-    ok = summary["ok"]
+    args = ap.parse_args(argv)
+    if args.fleet:
+        host_mixes = [m for m in MIXES if "schedule" not in m[1]]
+        summary = sweep(
+            args.seeds, args.base_seed, triage_dir=args.triage_dir or None,
+            mixes=host_mixes,
+        )
+        print(json.dumps(summary))
+        fleet_summary = sweep_fleet(
+            args.seeds, args.base_seed, triage_dir=args.triage_dir or None,
+        )
+        print(json.dumps(fleet_summary))
+        ok = summary["ok"] and fleet_summary["ok"]
+    else:
+        summary = sweep(
+            args.seeds, args.base_seed, triage_dir=args.triage_dir or None
+        )
+        print(json.dumps(summary))
+        ok = summary["ok"]
     if args.sharded:
         import os
         import subprocess
@@ -383,7 +537,8 @@ def main(argv=None) -> int:
         code = ge.virtual_cpu_bootstrap(8) + (
             "import json\n"
             "from tpu_paxos.harness import stress\n"
-            f"s = stress.sweep_sharded(n_seeds=2, base_seed={args.base_seed})\n"
+            f"s = stress.sweep_sharded(n_seeds=2, base_seed={args.base_seed},"
+            f" triage_dir={(args.triage_dir or None)!r})\n"
             "print('STRESS_SHARDED:' + json.dumps(s))\n"
         )
         proc = subprocess.run(
