@@ -11,7 +11,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "0*.py")))
 
 
-@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+# 04_sharded_and_checkpoint is the heaviest example (~60-85s: sharded
+# engine + checkpoint round-trip in a cold subprocess) and its coverage
+# is carried fast-tier by test_sharded / test_checkpoint /
+# test_sharded_repro, so it runs slow-tier to hold the tier-1 time
+# budget.
+@pytest.mark.parametrize(
+    "path",
+    [
+        pytest.param(
+            p,
+            id=os.path.basename(p),
+            marks=[pytest.mark.slow]
+            if os.path.basename(p).startswith("04_")
+            else [],
+        )
+        for p in EXAMPLES
+    ],
+)
 def test_example_runs(path):
     # The axon sitecustomize initializes the backend before env vars
     # are read, so JAX_PLATFORMS=cpu in the env is silently ignored —

@@ -146,18 +146,21 @@ def test_per_lane_workloads_same_template(fleet_fixture):
     assert rep.verdict.ok.all(), rep.verdict
 
 
-def test_runner_rejects_workload_changing_expected_set():
-    runner = frun.FleetRunner(_cfg(), WL)
+def test_runner_rejects_workload_outside_envelope(fleet_fixture):
+    """The PR-4 expected-set/owner guard is GONE — vid sets and owner
+    maps are runtime verdict tables now (tests/test_knobs.py covers
+    the accepted cases) — but the envelope's STATIC facts still
+    reject: vids past the bitmap bound, and more distinct vids than
+    the verdict table holds."""
+    runner, _ = fleet_fixture
     other = [np.arange(300, 310, dtype=np.int32),
              np.arange(400, 410, dtype=np.int32)]
-    with pytest.raises(ValueError, match="expected-vid set"):
+    with pytest.raises(ValueError, match="vid bound"):
         runner.run([0], [None], workloads=[(other, None)])
-    # same vid SET but a value swapped between proposers: the verdict's
-    # crash-excusal owner map would be wrong — must be rejected too
-    swapped = [w.copy() for w in WL]
-    swapped[0][0], swapped[1][0] = WL[1][0], WL[0][0]
-    with pytest.raises(ValueError, match="owner"):
-        runner.run([0], [None], workloads=[(swapped, None)])
+    # same vid range but more DISTINCT vids than the template's table
+    wider = [np.arange(100, 111, dtype=np.int32), WL[1]]
+    with pytest.raises(ValueError, match="distinct vids"):
+        runner.run([0], [None], workloads=[(wider, None)])
 
 
 def test_mesh_tile_bitwise_parity(fleet_fixture):

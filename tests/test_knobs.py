@@ -1,0 +1,295 @@
+"""Runtime i.i.d. fault knobs (core/net.FaultKnobs) and the
+one-executable stress envelope (fleet/envelope.py).
+
+The contract under test: an engine built with ``runtime_knobs=True``
+(knobs as traced scalars, always-on masked sampling) is decision-log
+IDENTICAL to the compile-time engine per (cfg, schedule, seed) — over
+a knob grid spanning all-zero knobs, the reference debug.conf rates,
+``max_delay`` at the envelope's ring edge, and a crash+pause mix —
+and the envelope cache hands every caller of one envelope the same
+compiled executable, so distinct knob mixes, schedules, and shrink
+candidates cost dispatches, not compiles.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_paxos.analysis import tracecount
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import net as netm
+from tpu_paxos.core import sim as simm
+from tpu_paxos.fleet import envelope as env
+from tpu_paxos.replay.decision_log import decision_log
+from tpu_paxos.utils import prng
+
+WL = [np.arange(100, 108, dtype=np.int32),
+      np.arange(200, 208, dtype=np.int32)]
+
+SCHED = flt.FaultSchedule((
+    flt.partition(4, 16, (0, 1), (2, 3, 4)),
+    flt.pause(6, 14, 2),
+    flt.burst(5, 12, 1500),
+))
+
+
+def _cfg(n_nodes, fkw, seed=3, max_rounds=4000):
+    return SimConfig(
+        n_nodes=n_nodes, n_instances=48, proposers=(0, 1), seed=seed,
+        max_rounds=max_rounds, faults=FaultConfig(**fkw),
+    )
+
+
+def _log_sha(r):
+    stride = int(max(int(np.max(w)) for w in WL)) + 1
+    text = decision_log(
+        r.chosen_vid, r.chosen_ballot, stride=stride,
+        n_instances=len(r.chosen_vid),
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _assert_knob_parity(cfg):
+    """Static single-run vs a 1-lane dispatch of the shared envelope
+    runner: same decision-log sha256 AND bit-identical result arrays
+    for the same (cfg, schedule, seed)."""
+    a = simm.run(cfg, WL)
+    runner = env.runner_for(cfg, WL)
+    fc = cfg.faults
+    rep = runner.run(
+        [cfg.seed], [fc.schedule],
+        workloads=[(WL, None)],
+        knobs=[dataclasses.replace(fc, schedule=None)],
+    )
+    b = rep.lane_result(0)
+    assert a.rounds == b.rounds, (a.rounds, b.rounds)
+    assert _log_sha(a) == _log_sha(b)
+    assert (a.chosen_vid == b.chosen_vid).all()
+    assert (a.chosen_round == b.chosen_round).all()
+    assert (a.learned == b.learned).all()
+    assert (a.crashed == b.crashed).all()
+    assert a.done == b.done
+    # the lane round-trips to the exact single-run config it mirrors
+    # (knobs and schedule baked back over the envelope-normalized base)
+    assert rep.lane_cfg(0) == cfg
+    return rep
+
+
+# ---------------- copy_plan: the sampling layer ----------------
+
+
+def test_copy_plan_knob_parity():
+    """The always-on masked forms sample bit-identically to the
+    static branches for equal knob values — including zero knobs
+    (elided branches) and burst extra_drop composition."""
+    key = prng.stream(prng.root_key(9), prng.STREAM_NET_DROP, 5)
+    shape = (2, 5)
+    cells = [
+        FaultConfig(),
+        FaultConfig(drop_rate=500),
+        FaultConfig(dup_rate=1000),
+        FaultConfig(min_delay=1, max_delay=4),
+        FaultConfig(drop_rate=500, dup_rate=1000, min_delay=0, max_delay=2),
+    ]
+    for fc in cells:
+        for extra in (None, jnp.int32(1500)):
+            al_s, dl_s = netm.copy_plan(key, shape, fc, extra_drop=extra)
+            al_k, dl_k = netm.copy_plan(
+                key, shape, fc, extra_drop=extra,
+                knobs=jax.tree.map(jnp.asarray, netm.knobs_from_faults(fc)),
+            )
+            assert (np.asarray(al_s) == np.asarray(al_k)).all(), fc
+            assert (np.asarray(dl_s) == np.asarray(dl_k)).all(), fc
+
+
+def test_runtime_knobs_round_fn_requires_knobs():
+    cfg = _cfg(3, dict(max_delay=2))
+    pend, gate, tail, c = simm.prepare_queues(cfg, WL)
+    rf = simm.build_engine(
+        cfg, c, vid_cap=0, runtime_schedule=True, runtime_knobs=True
+    )
+    root = prng.root_key(0)
+    st = simm.init_state(cfg, pend, gate, tail, root)
+    from tpu_paxos.fleet import schedule_table as stm
+
+    tab = jax.tree.map(jnp.asarray, stm.encode_schedule(None, cfg.n_nodes, 1))
+    with pytest.raises(TypeError, match="FaultKnobs"):
+        rf(root, st, tab)
+    with pytest.raises(TypeError, match="ScheduleTable"):
+        rf(root, st, None)
+
+
+# ---------------- decision-log parity grid ----------------
+
+
+def test_knob_parity_zero_and_debugconf():
+    """Fast grid cells: all-zero knobs and the reference debug.conf
+    rates (drop 500 / dup 1000 / delay 2), 3-node geometry.  Both
+    cells ride ONE cached envelope executable (the second pays no
+    compile — pinned below by the census delta)."""
+    census = tracecount.CompileCensus().start()
+    _assert_knob_parity(_cfg(3, dict()))
+    before = census.engine_counts.get("fleet", 0)
+    _assert_knob_parity(
+        _cfg(3, dict(drop_rate=500, dup_rate=1000, max_delay=2))
+    )
+    census.stop()
+    assert census.engine_counts.get("fleet", 0) == before, (
+        "second knob cell recompiled the fleet executable — the "
+        "envelope cache should have served the first cell's"
+    )
+
+
+@pytest.mark.slow
+def test_knob_parity_envelope_edge_and_crash_pause():
+    """Heavy grid cells, 5-node geometry: ``max_delay`` at the
+    envelope's ring edge (the bound itself), and a crash+pause mix
+    over a schedule with all three mask dimensions."""
+    _assert_knob_parity(
+        _cfg(5, dict(drop_rate=200, dup_rate=200, min_delay=2,
+                     max_delay=env.MAX_DELAY_BOUND))
+    )
+    _assert_knob_parity(
+        _cfg(5, dict(drop_rate=500, dup_rate=1000, max_delay=2,
+                     crash_rate=3000, schedule=SCHED))
+    )
+
+
+# ---------------- envelope cache ----------------
+
+
+def test_envelope_cache_identity_and_keying():
+    cfg = _cfg(3, dict(max_delay=2))
+    r1 = env.runner_for(cfg, WL)
+    # different knob mix, same envelope -> same compiled runner
+    r2 = env.runner_for(
+        _cfg(3, dict(drop_rate=2000, dup_rate=500, max_delay=4))
+    , WL)
+    assert r1 is r2
+    # the cached runner is knob-normalized to the envelope
+    assert r1.cfg.faults.schedule is None
+    assert r1.cfg.faults.max_delay == env.MAX_DELAY_BOUND
+    # geometry / budget / ring-bound changes are different envelopes
+    assert env.runner_for(
+        _cfg(3, dict(max_delay=2), max_rounds=2000), WL
+    ) is not r1
+    assert env.runner_for(cfg, WL, delay_bound=12) is not r1
+    # a cfg whose max_delay exceeds the requested bound is rejected
+    with pytest.raises(ValueError, match="delay bound"):
+        env.runner_for(_cfg(3, dict(max_delay=6)), WL, delay_bound=4)
+
+
+def test_runner_knob_validation():
+    runner = env.runner_for(_cfg(3, dict(max_delay=2)), WL)
+    wl1 = [(WL, None)]
+    # cache-shared runners REJECT implicit inputs: the cached
+    # template's queue order and base knobs belong to whichever
+    # caller warmed the cache (the cache normalizes knobs to zero,
+    # so run(knobs=None) would silently drop all faults)
+    with pytest.raises(ValueError, match="envelope cache"):
+        runner.run([0], [None], workloads=wl1)
+    with pytest.raises(ValueError, match="envelope cache"):
+        runner.run([0], [None], knobs=[FaultConfig()])
+    with pytest.raises(ValueError, match="one knob set per lane"):
+        runner.run([0, 1], [None, None], workloads=wl1 * 2,
+                   knobs=[FaultConfig()])
+    with pytest.raises(ValueError, match="ring bound"):
+        runner.run([0], [None], workloads=wl1,
+                   knobs=[FaultConfig(max_delay=12)])
+    with pytest.raises(ValueError, match="schedule"):
+        runner.run(
+            [0], [None], workloads=wl1,
+            knobs=[FaultConfig(schedule=flt.FaultSchedule(
+                (flt.burst(1, 3, 500),)
+            ))],
+        )
+    with pytest.raises(TypeError, match="FaultConfig or FaultKnobs"):
+        runner.run([0], [None], workloads=wl1, knobs=[{"drop_rate": 5}])
+
+
+def test_per_lane_vid_sets_are_runtime():
+    """Per-lane workloads may change the vid SET and the owner map —
+    the verdict's expected/owner tables are runtime inputs now (the
+    PR-4 guard is gone); only the envelope's vid bound and table
+    shapes are static."""
+    runner = env.runner_for(_cfg(3, dict(max_delay=2)), WL)
+    # swap a value between proposers (old guard's "owner" rejection)
+    swapped = [w.copy() for w in WL]
+    swapped[0][0], swapped[1][0] = WL[1][0], WL[0][0]
+    # shifted vid set inside the bound (old guard's "set" rejection)
+    shifted = [WL[0] + 1, WL[1][:-1]]
+    rep = runner.run(
+        [0, 1, 2], [None] * 3,
+        workloads=[(WL, None), (swapped, None), (shifted, None)],
+        knobs=[FaultConfig(drop_rate=300, max_delay=2)] * 3,
+    )
+    assert rep.verdict.ok.all(), rep.verdict
+    # each lane is judged against ITS OWN expected set
+    assert (rep.expected_lanes[1] == np.unique(np.concatenate(swapped))).all()
+    assert (rep.expected_lanes[2] == np.unique(np.concatenate(shifted))).all()
+    got = np.sort(rep.lane_result(2).chosen_vid)
+    for v in np.unique(np.concatenate(shifted)):
+        assert v in got
+    # vids past the envelope's bound stay rejected
+    with pytest.raises(ValueError, match="vid bound"):
+        runner.run(
+            [0], [None], workloads=[([WL[0], WL[1] + 700], None)],
+            knobs=[FaultConfig()],
+        )
+
+
+# ---------------- shrink rides the envelope ----------------
+
+
+@pytest.mark.slow
+def test_shrink_candidate_eval_matches_run_case():
+    """The runtime-knob candidate evaluator and the compile-time
+    ``run_case`` agree verdict-for-verdict (green case, failing case,
+    knob-zeroed candidate), and successive candidates add ZERO fleet
+    compiles — the greedy descent rides one executable.  Slow tier:
+    it runs both judges end to end (~45 s); the envelope-reuse census
+    pin stays fast-tier in test_knob_parity_zero_and_debugconf, and
+    shrink-vs-run_case agreement is re-verified on every triage
+    anyway (save_artifact re-judges on the compile-time path)."""
+    from tpu_paxos.harness import shrink as shr
+
+    sched = flt.FaultSchedule((flt.partition(5, 35, (0, 1), (2, 3, 4)),))
+    cfg = SimConfig(
+        n_nodes=5, n_instances=64, proposers=(0, 1), seed=7,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=300, dup_rate=500, max_delay=2,
+                           schedule=sched),
+    )
+    case = shr.ReproCase(
+        cfg=cfg, workload=WL, gates=None,
+        chains=[np.zeros(0, np.int32)] * 2,
+        extra_checks={"decision_round_max": 25},
+    )
+    ev = shr._runtime_candidate_eval(case)
+    assert ev is not None
+    _, viol = shr.run_case(case)
+    assert viol and "decision_round_max" in viol
+    assert ev(case) == viol
+    census = tracecount.CompileCensus().start()
+    # knob-zeroed and schedule-dropped candidates: same executable
+    zeroed = case.with_faults(
+        dataclasses.replace(cfg.faults, drop_rate=0, dup_rate=0)
+    )
+    healed = case.with_schedule(None)
+    _, v_zero = shr.run_case(zeroed)
+    _, v_heal = shr.run_case(healed)
+    assert ev(zeroed) == v_zero
+    assert ev(healed) == v_heal
+    census.stop()
+    assert census.engine_counts.get("fleet", 0) == 0, (
+        "shrink candidates recompiled the fleet executable"
+    )
+    # sharded cases stay on the compile-time path
+    assert shr._runtime_candidate_eval(
+        dataclasses.replace(case, engine="sharded", devices=2)
+    ) is None

@@ -38,12 +38,23 @@ def test_stress_fleet_sweep_smoke():
 def test_stress_fleet_matches_host_loop(monkeypatch):
     """The --fleet route must judge exactly the runs the host loop
     judges: same (mix, seed) grid, both green — and the fleet's lanes
-    ARE those runs (decision-log parity pinned in test_fleet.py)."""
+    ARE those runs (decision-log parity pinned in test_fleet.py).
+    The mixes differ in their i.i.d. knob rates as well as their
+    schedules, and both are runtime inputs now: the second mix must
+    reuse the first mix's envelope executable (compiles_per_mix == 0
+    — the one-executable stress-envelope ratchet)."""
+    from tpu_paxos.fleet import envelope
+
+    envelope.clear_cache()  # a cold cache so the first mix compiles
     mixes = stress.EPISODE_MIXES[:2]
     host = stress.sweep(n_seeds=2, verbose=False, mixes=mixes)
     fleet = stress.sweep_fleet(n_seeds=2, verbose=False, mixes=mixes)
     assert host["ok"] and fleet["ok"]
     assert host["runs"] == fleet["runs"] == 4
+    cpm = fleet["compiles_per_mix"]
+    assert list(cpm) == [m[0] for m in mixes]
+    assert cpm[mixes[0][0]] > 0, cpm  # cold envelope compiled here
+    assert cpm[mixes[1][0]] == 0, cpm  # ...and served this mix
 
 
 @pytest.mark.slow

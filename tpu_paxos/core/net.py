@@ -51,11 +51,55 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_paxos.config import FaultConfig
 from tpu_paxos.core import ballot as bal
 
 MAX_COPIES = 4  # original + up to 3 recursive duplicates, ref multi/main.cpp:120
+
+
+class FaultKnobs(NamedTuple):
+    """The i.i.d. fault knobs as RUNTIME values: traced int32 scalars
+    (or ``[lanes]`` vectors under the fleet vmap) instead of
+    compile-time constants baked into the engine closure.
+
+    This is what makes ONE compiled executable cover every stress
+    mix: ``copy_plan`` with ``knobs=`` samples in always-on masked
+    form — ``randint(.., 0, 10000) < rate`` is all-false at rate 0
+    and a ``[0, 0]`` delay span samples 0 — so a zero knob produces
+    bit-identical draws to the static path's elided branch (the PRNG
+    keys are split per site, not consumed sequentially, and
+    ``jax.random.randint``'s bits depend only on key/shape/dtype).
+    Decision-log sha256 parity with the compile-time path is pinned
+    per (cfg, schedule, seed) by tests/test_knobs.py.
+
+    ``max_delay`` must stay <= the engine's ring envelope bound
+    (``cfg.faults.max_delay`` of the engine the knobs are fed to —
+    the arrival ring is statically sized to ``bound + 2`` slots);
+    callers enforce this host-side (fleet/runner.py).  The ring size
+    itself is decision-log-neutral: a message sent at ``t`` with
+    delay ``d <= S - 2`` always pops at round ``t + 1 + d``.
+    """
+
+    drop_rate: jax.Array  # int32, per 1e4 (THNetWork semantics)
+    dup_rate: jax.Array  # int32, per 1e4
+    min_delay: jax.Array  # int32 rounds
+    max_delay: jax.Array  # int32 rounds, <= the engine's envelope bound
+    crash_rate: jax.Array  # int32, per 1e6 (member/ RandomFailure)
+
+
+def knobs_from_faults(fc: FaultConfig) -> FaultKnobs:
+    """Host-side encoding of a FaultConfig's i.i.d. knobs (the
+    schedule is NOT part of the knobs — it rides the runtime
+    ScheduleTable, fleet/schedule_table.py)."""
+    return FaultKnobs(
+        drop_rate=np.int32(fc.drop_rate),
+        dup_rate=np.int32(fc.dup_rate),
+        min_delay=np.int32(fc.min_delay),
+        max_delay=np.int32(fc.max_delay),
+        crash_rate=np.int32(fc.crash_rate),
+    )
 
 
 class NetBuffers(NamedTuple):
@@ -119,6 +163,7 @@ def copy_plan(
     edge_shape: tuple[int, ...],
     fc: FaultConfig,
     extra_drop=None,
+    knobs: FaultKnobs | None = None,
 ):
     """Sample the THNetWork fault plan for one broadcast/send.
 
@@ -134,8 +179,37 @@ def copy_plan(
     it adds to ``fc.drop_rate``, clamped to 10_000.  Engines pass it
     only when the schedule contains burst episodes, so burst-free
     configs keep the static drop-sampling elision.
+
+    With ``knobs`` set the rates/delays come from the traced
+    :class:`FaultKnobs` instead of ``fc`` and every branch runs in
+    its always-on masked form — exact when a knob is zero (see the
+    FaultKnobs docstring for the parity argument), so one executable
+    serves every knob mix.
     """
     k_drop, k_dup, k_delay = jax.random.split(key, 3)
+    if knobs is not None:
+        rate = jnp.asarray(knobs.drop_rate, jnp.int32)
+        if extra_drop is not None:
+            rate = jnp.minimum(rate + extra_drop, 10_000)
+        drop = jax.random.randint(k_drop, edge_shape, 0, 10_000) < rate
+        coins = (
+            jax.random.randint(k_dup, (MAX_COPIES - 1, *edge_shape), 0, 10_000)
+            < jnp.asarray(knobs.dup_rate, jnp.int32)
+        )
+        dup1 = coins[0]
+        dup2 = dup1 & coins[1]
+        dup3 = dup2 & coins[2]
+        alive = jnp.concatenate(
+            [(~drop)[None], jnp.stack([dup1, dup2, dup3])], axis=0
+        )
+        delay = jax.random.randint(
+            k_delay,
+            (MAX_COPIES, *edge_shape),
+            jnp.asarray(knobs.min_delay, jnp.int32),
+            jnp.asarray(knobs.max_delay, jnp.int32) + 1,
+            dtype=jnp.int32,
+        )
+        return alive, delay
     if extra_drop is not None:
         rate = jnp.minimum(jnp.int32(fc.drop_rate) + extra_drop, 10_000)
         drop = jax.random.randint(k_drop, edge_shape, 0, 10_000) < rate

@@ -386,10 +386,30 @@ def build_engine(
     vid_cap: int = 0,
     use_pallas: bool | None = None,
     runtime_schedule: bool = False,
+    runtime_knobs: bool = False,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
     the state; everything shape-like is baked in.
+
+    With ``runtime_knobs=True`` the i.i.d. fault knobs are NOT baked
+    in either: ``round_fn(root, state, tab, knobs)`` takes a traced
+    ``net.FaultKnobs`` (drop/dup/delay/crash as int32 scalars) and
+    every ``if fc.*`` Python branch below runs in its always-on
+    masked form — drop/dup coins compared against the traced rates
+    (all-false at rate 0), the delay drawn from the traced
+    ``[min_delay, max_delay]`` span (a ``[0, 0]`` span samples 0),
+    crash injection against the traced crash rate, and the
+    crash-coupled cached blocks (commit-ack refresh, quiescence
+    counts) always-on (exact: the caches are only ever skipped when
+    provably current, so measuring every round returns the same
+    values).  ``cfg.faults.max_delay`` then acts as the ENVELOPE
+    delay bound: it sizes the arrival ring (``init_state``), and
+    every per-call ``knobs.max_delay`` must stay <= it (enforced
+    host-side by fleet/runner.py; the ring size itself is
+    decision-log-neutral).  Decision-log sha256 parity with the
+    compile-time path is pinned per (cfg, schedule, seed) by
+    tests/test_knobs.py.
 
     With ``runtime_schedule=True`` the correlated-fault schedule is NOT
     baked in: ``round_fn(root, state, tab)`` takes a traced
@@ -508,11 +528,16 @@ def build_engine(
     def rany(b):
         return jnp.any(b)
 
-    def round_fn(root: jax.Array, st: SimState, tab=None) -> SimState:
+    def round_fn(root: jax.Array, st: SimState, tab=None, knobs=None) -> SimState:
         if runtime_schedule and tab is None:
             raise TypeError(
                 "this engine was built with runtime_schedule=True; "
                 "round_fn needs a ScheduleTable argument"
+            )
+        if runtime_knobs and knobs is None:
+            raise TypeError(
+                "this engine was built with runtime_knobs=True; "
+                "round_fn needs a FaultKnobs argument"
             )
         # queue rows must be pre-padded by the window width (see
         # prepare_queues) so window ops are copy-free dynamic slices.
@@ -584,7 +609,10 @@ def build_engine(
             return m if reach_ap is None else m & reach_ap
 
         def _plan(key, edge_shape):
-            return netm.copy_plan(key, edge_shape, fc, extra_drop=xdrop_t)
+            return netm.copy_plan(
+                key, edge_shape, fc, extra_drop=xdrop_t,
+                knobs=knobs if runtime_knobs else None,
+            )
 
         keys = jax.random.split(prng.stream(root, prng.STREAM_NET_DROP, t), 8)
 
@@ -1076,7 +1104,12 @@ def build_engine(
             ))  # [P]
             return ca, wait
 
-        if fc.crash_rate:
+        if runtime_knobs or fc.crash_rate:
+            # Runtime knobs may carry a nonzero crash rate, so the
+            # cached flag refreshes every round (exact at crash rate 0:
+            # without crashes the excusal never clears without an
+            # arrival, so the cond-gated path below computes the same
+            # values).
             commit_acked, commit_wait = _accum_commit_acks(pr.commit_acked)
         else:
             commit_acked, commit_wait = jax.lax.cond(
@@ -1479,10 +1512,17 @@ def build_engine(
 
         # ---------------- crash injection ----------------
         crashed = st.crashed
-        if fc.crash_rate:
+        if runtime_knobs or fc.crash_rate:
+            # Always-on under runtime knobs: the draw consumes only
+            # its own stream key, and a zero traced rate makes `want`
+            # all-false — identical to the elided static branch.
             ku = prng.stream(root, prng.STREAM_CRASH, t)
             u = jax.random.randint(ku, (a,), 0, 1_000_000)
-            want = (u < fc.crash_rate) & ~crashed
+            c_rate = (
+                jnp.asarray(knobs.crash_rate, jnp.int32)
+                if runtime_knobs else fc.crash_rate
+            )
+            want = (u < c_rate) & ~crashed
             room = max_crash - jnp.sum(crashed)
             allow = jnp.cumsum(want.astype(jnp.int32)) <= room
             crashed = crashed | (want & allow)
@@ -1531,7 +1571,10 @@ def build_engine(
                 jnp.where(met.chosen_vid != val.NONE, idx, -1)
             ))
 
-        if fc.crash_rate:
+        if runtime_knobs or fc.crash_rate:
+            # Runtime knobs: measure every round (a runtime crash can
+            # excuse learners without any arrival; exact at rate 0 —
+            # the cache is only ever skipped when provably current).
             sums, hmax = _measure(None)
         else:
             sums, hmax = jax.lax.cond(
@@ -1749,6 +1792,31 @@ def _run_loop(cfg: SimConfig, round_fn):
     return _go
 
 
+def _run_loop_knobs(cfg: SimConfig, round_fn):
+    """Whole-run driver for a ``runtime_schedule + runtime_knobs``
+    engine: the schedule table AND the i.i.d. knobs arrive per call,
+    so one executable serves every (schedule, knob, seed) mix of the
+    envelope.  The round cap is ``max_rounds`` past the table's own
+    (traced) horizon — the same heal-then-converge budget as
+    ``cfg.round_budget`` on the constant path.  This is the surface
+    the fleet runner vmaps (fleet/runner.py) and the IR audit traces
+    as ``sim.run_rounds_knobs``."""
+
+    @jax.jit
+    def _go(root, state, tab, knobs):
+        def cond(st):
+            return (~st.done) & (
+                st.t < cfg.max_rounds + jnp.asarray(tab.horizon, jnp.int32)
+            )
+
+        def body(st):
+            return round_fn(root, st, tab, knobs)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    return _go
+
+
 def to_result(final: SimState, expected_vids: np.ndarray) -> SimResult:
     """Marshal a final device state into the host-convention result
     (shared by run_state, the sharded runner, and the stress sweep)."""
@@ -1844,6 +1912,37 @@ def audit_entries():
         state = init_state(cfg, pend, gate, tail, root)
         return _run_loop(cfg, build_engine(cfg, c, vid_cap=0)), (root, state)
 
+    def build_knobs():
+        # The one-executable stress-envelope surface: schedule AND
+        # i.i.d. knobs as traced runtime inputs (runtime_schedule +
+        # runtime_knobs).  The envelope delay bound sizes the ring;
+        # IR205's const budget watches that no schedule/knob table
+        # sneaks back in as a baked constant.
+        from tpu_paxos.fleet import schedule_table as stm
+
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
+        )
+        workload = default_workload(cfg)
+        pend, gate, tail, c = prepare_queues(cfg, workload, None)
+        root = prng.root_key(cfg.seed)
+        state = init_state(cfg, pend, gate, tail, root)
+        sched = fltm.FaultSchedule((
+            fltm.partition(2, 10, (0,), (1, 2)),
+            fltm.pause(3, 8, 2),
+        ))
+        tab = jax.tree.map(
+            jnp.asarray, stm.encode_schedule(sched, cfg.n_nodes, 4)
+        )
+        knobs = jax.tree.map(
+            jnp.asarray, netm.knobs_from_faults(cfg.faults)
+        )
+        rf = build_engine(
+            cfg, c, vid_cap=0, runtime_schedule=True, runtime_knobs=True
+        )
+        return _run_loop_knobs(cfg, rf), (root, state, tab, knobs)
+
     ir204_why = (
         "conflict-requeue compaction sorts on provably-unique keys "
         "(global instance ids / window offsets); instability cannot "
@@ -1858,6 +1957,11 @@ def audit_entries():
         ),
         AuditEntry(
             "sim.run_rounds_episodes", build_episodes,
+            allow=("IR204",), why=ir204_why,
+        ),
+        AuditEntry(
+            "sim.run_rounds_knobs", build_knobs,
+            covers=("_run_loop_knobs",),
             allow=("IR204",), why=ir204_why,
         ),
     ]

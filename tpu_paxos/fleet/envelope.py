@@ -1,0 +1,140 @@
+"""Envelope-keyed executable cache: ONE compiled fleet program per
+stress envelope, shared by the stress sweep, the schedule search, and
+the greedy shrinker.
+
+An *envelope* is everything the compiled lane program actually bakes
+in: the cluster geometry (nodes / proposers / instances), the
+protocol knobs, the round budget, the queue/table shapes of the
+workload template, the schedule-table episode capacity, the verdict's
+vid space, and the DELAY RING BOUND (the arrival calendars are
+statically sized to ``max_delay + 2`` slots).  Everything else — the
+seed, the episode schedule, the i.i.d. fault knobs, and the workload
+vids — is a runtime input of the cached executable
+(``fleet/runner.FleetRunner`` built with ``runtime_schedule`` +
+``runtime_knobs``).
+
+``runner_for`` normalizes a caller's config onto its envelope
+(schedule stripped, i.i.d. knobs zeroed, ``max_delay`` raised to the
+ring bound) and memoizes one :class:`~tpu_paxos.fleet.runner.FleetRunner`
+per distinct envelope key.  Distinct knob mixes, schedules, and
+shrink candidates then cost dispatches, not compiles: all four stress
+episode mixes share one (5-node, 2-proposer) envelope, a knob sweep
+is a knob vector, and every greedy-shrink candidate of a case rides
+the same executable its sweep compiled.
+
+Cache discipline: the key pins the template's expected-vid/owner
+TABLES and shapes, not its queue ORDER — callers that depend on a
+specific queue order (everyone: decision logs are order-sensitive)
+must pass explicit per-lane ``workloads=`` to ``run()`` rather than
+relying on the cached runner's template queues.  The stress sweep,
+the search, and the shrinker all do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.fleet import runner as frun
+from tpu_paxos.fleet import verdict as vdt
+
+#: Default envelope delay-ring bound: covers every stress mix's
+#: ``max_delay`` (the sweep peaks at 6) with headroom, so all mixes of
+#: a geometry share one ring size — ring size is decision-log-neutral
+#: (net.FaultKnobs docstring) and the [S, P, A] calendars are tiny.
+MAX_DELAY_BOUND = 8
+
+_CACHE: dict = {}
+
+
+def clear_cache() -> None:
+    """Drop every cached runner (tests; frees the compiled
+    executables with them)."""
+    _CACHE.clear()
+
+
+def _mesh_key(mesh):
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(d.id) for d in np.asarray(mesh.devices).reshape(-1)),
+    )
+
+
+def envelope_key(
+    cfg: SimConfig,
+    workload,
+    gates,
+    max_episodes: int,
+    delay_bound: int,
+    mesh,
+) -> tuple:
+    """The hashable envelope of a (cfg, workload-template) pair —
+    exactly the static facts the compiled lane program depends on."""
+    wl = [np.asarray(w, np.int32).reshape(-1) for w in workload]
+    expected, owner = vdt.expected_owners(cfg, wl)
+    gate_sig = (
+        None if gates is None
+        else tuple(len(np.asarray(g).reshape(-1)) for g in gates)
+    )
+    return (
+        cfg.n_nodes,
+        cfg.proposers,
+        cfg.n_instances,
+        cfg.assign_window,
+        cfg.max_rounds,
+        dataclasses.astuple(cfg.protocol),
+        int(delay_bound),
+        int(max_episodes),
+        tuple(len(w) for w in wl),
+        gate_sig,
+        tuple(int(v) for v in expected),
+        tuple(int(o) for o in owner),
+        simm.gates_vid_cap(wl, gates),
+        _mesh_key(mesh),
+    )
+
+
+def runner_for(
+    cfg: SimConfig,
+    workload,
+    gates=None,
+    *,
+    max_episodes: int = frun.MAX_EPISODES,
+    delay_bound: int | None = None,
+    mesh=None,
+) -> frun.FleetRunner:
+    """The shared compiled runner for ``cfg``'s envelope.
+
+    ``cfg.faults`` is normalized away (the i.i.d. knobs and the
+    schedule are runtime inputs of the returned runner — pass them to
+    ``run()`` per lane); only ``cfg.faults.max_delay`` survives, as a
+    floor on the ring bound.  Callers MUST pass explicit per-lane
+    ``workloads=`` and ``knobs=`` to ``run()`` — the cache does not
+    pin the template's queue order or the base knob mix (enforced:
+    the returned runner rejects implicit inputs)."""
+    if delay_bound is None:
+        delay_bound = max(cfg.faults.max_delay, MAX_DELAY_BOUND)
+    if cfg.faults.max_delay > delay_bound:
+        raise ValueError(
+            f"cfg max_delay {cfg.faults.max_delay} exceeds the "
+            f"requested envelope delay bound {delay_bound}"
+        )
+    key = envelope_key(cfg, workload, gates, max_episodes, delay_bound, mesh)
+    runner = _CACHE.get(key)
+    if runner is None:
+        base = dataclasses.replace(
+            cfg, seed=0, faults=FaultConfig(max_delay=delay_bound)
+        )
+        runner = frun.FleetRunner(
+            base, workload, gates, mesh=mesh, max_episodes=max_episodes
+        )
+        # the MUST above is enforced: run() rejects implicit
+        # workloads/knobs on cache-shared runners
+        runner.explicit_inputs_only = True
+        _CACHE[key] = runner
+    return runner

@@ -1,26 +1,36 @@
-"""Device-batched fleet runner: many (seed x schedule) lanes of the
-general engine per XLA dispatch, judged on device.
+"""Device-batched fleet runner: many (seed x schedule x knob-mix)
+lanes of the general engine per XLA dispatch, judged on device.
 
 ``core/sim`` runs ONE simulation per host-loop iteration; the stress
 sweep therefore pays a dispatch (and, per episode mix, a compile) per
 seed.  The fleet instead ``vmap``s the engine's whole-run surface —
 the ``lax.while_loop`` over ``round_fn`` that ``sim._run_loop``
-drives — over a LANE axis of PRNG roots, initial states, and runtime
-schedule tables (``fleet/schedule_table.py``), with the per-lane
-invariant subset (``fleet/verdict.py``) reduced to a ``[lanes]``
-verdict vector inside the same jit.  One compiled executable then
-covers every (seed, episode-mix) combination of a fixed geometry, and
+drives — over a LANE axis of PRNG roots, initial states, runtime
+schedule tables (``fleet/schedule_table.py``), runtime i.i.d. fault
+knobs (``core/net.FaultKnobs``: drop/dup/delay/crash as traced
+``[lanes]`` vectors), and runtime workload tables (the per-lane queue
+arrays plus the verdict's expected-vid/owner tables), with the
+per-lane invariant subset (``fleet/verdict.py``) reduced to a
+``[lanes]`` verdict vector inside the same jit.  One compiled
+executable then covers every (seed, episode-mix, knob-mix, workload)
+combination of a fixed ENVELOPE — ``(n_nodes, n_instances,
+max_delay bound, max_episodes)`` plus the queue/table shapes — and
 only failing lanes ever pay host transfer + the full
 ``harness/validate`` suite + the ``harness/shrink.py`` repro path.
+``fleet/envelope.py`` keys a shared runner cache on exactly that
+envelope, so the stress sweep, the schedule search, and the greedy
+shrinker all reuse one compile.
 
 Lane-for-lane the fleet is DECISION-LOG-IDENTICAL to single
 ``core/sim.run`` executions of the same (cfg, schedule, seed):
 ``jax_threefry_partitionable`` (pinned in utils/prng) makes the
-batched PRNG draws equal the per-lane draws, and the runtime mask
+batched PRNG draws equal the per-lane draws, the runtime mask
 computation equals the compiled tables row for row
-(tests/test_fleet.py pins the sha256 per lane).  That parity is what
-lets a wedge found in a fleet lane be re-run, shrunk, and replayed by
-the ordinary single-run triage stack.
+(tests/test_fleet.py pins the sha256 per lane), and the runtime-knob
+sampling equals the static branches knob for knob
+(tests/test_knobs.py pins the sha256 over a knob grid).  That parity
+is what lets a wedge found in a fleet lane be re-run, shrunk, and
+replayed by the ordinary single-run triage stack.
 
 Scale-out: the lane axis tiles over a device mesh via ``shard_map``
 (lanes are independent — no collectives), so a v5e-8 runs 8x the
@@ -38,8 +48,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_paxos.analysis import tracecount
-from tpu_paxos.config import SimConfig
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import net as netm
 from tpu_paxos.core import sim as simm
+from tpu_paxos.core import values as val
 from tpu_paxos.fleet import schedule_table as stm
 from tpu_paxos.fleet import verdict as vdt
 from tpu_paxos.utils import prng
@@ -74,8 +86,15 @@ class FleetReport:
     schedules: list
     verdict: vdt.LaneVerdict  # host numpy, [lanes] per field
     final: simm.SimState  # device, lane-leading
-    expected: np.ndarray
+    expected: np.ndarray  # the runner's template expected-vid set
     seconds: float
+    #: per-lane i.i.d. FaultConfig (schedule-free) — the knob mix each
+    #: lane actually ran, whether passed explicitly or defaulted from
+    #: the runner's base cfg; the source ``lane_cfg`` bakes back in.
+    fault_cfgs: list = dataclasses.field(default_factory=list)
+    #: per-lane expected-vid arrays (== ``expected`` for template
+    #: lanes; per-lane for runtime workload tables)
+    expected_lanes: list = dataclasses.field(default_factory=list)
 
     @property
     def lanes_per_sec(self) -> float:
@@ -89,25 +108,33 @@ class FleetReport:
         """Transfer ONE lane's final state and marshal it as the
         single-run result type (the full-suite / shrink hand-off)."""
         one = jax.tree.map(lambda x: x[i], self.final)
-        return simm.to_result(one, self.expected)
+        exp = self.expected_lanes[i] if self.expected_lanes else self.expected
+        return simm.to_result(one, exp)
 
     def lane_cfg(self, i: int) -> SimConfig:
         """The single-run config this lane is decision-log-identical
-        to: base cfg with the lane's seed and schedule baked back in."""
+        to: base cfg with the lane's seed, i.i.d. knobs, and schedule
+        baked back in."""
+        fc = self.fault_cfgs[i] if self.fault_cfgs else self.cfg.faults
         return dataclasses.replace(
             self.cfg,
             seed=self.seeds[i],
-            faults=dataclasses.replace(
-                self.cfg.faults, schedule=self.schedules[i]
-            ),
+            faults=dataclasses.replace(fc, schedule=self.schedules[i]),
         )
 
 
 class FleetRunner:
-    """Compile-once fleet front end for one geometry: the jitted
+    """Compile-once fleet front end for one envelope: the jitted
     vmapped (and optionally shard_map-tiled) lane program plus its
     static workload template.  ``run()`` is called per generation /
-    per mix with fresh seeds and schedules — same executable."""
+    per mix / per shrink candidate with fresh seeds, schedules, knob
+    vectors, and workload tables — same executable.
+
+    ``cfg.faults`` plays two roles: its ``max_delay`` is the
+    envelope's RING BOUND (every lane's runtime ``max_delay`` must
+    stay <= it), and its i.i.d. knobs are the default per-lane knob
+    mix when ``run(knobs=None)``.  ``cfg.faults.schedule`` must be
+    None — schedules are per-lane runtime tables."""
 
     def __init__(
         self,
@@ -127,25 +154,43 @@ class FleetRunner:
         self.gates = gates
         self.mesh = mesh
         self.max_episodes = max_episodes
+        self.delay_bound = cfg.faults.max_delay
+        #: set by fleet/envelope.runner_for: a cache-shared runner's
+        #: template queues and base knobs are whatever caller warmed
+        #: the cache, so run() REQUIRES explicit workloads= and knobs=
+        self.explicit_inputs_only = False
         self.expected, self.owner = vdt.expected_owners(cfg, self.workload)
+        #: static bitmap bound of the verdict's chosen-membership
+        #: bitmap — the envelope's vid space; every lane's vids must
+        #: fall below it
+        self.vid_bound = (
+            int(self.expected.max()) + 1 if self.expected.size else 1
+        )
+        #: static width of the per-lane expected/owner tables; lanes
+        #: with fewer distinct vids pad with -1 (vacuously covered)
+        self.v_cap = max(len(self.expected), 1)
         pend, gate, tail, c = simm.prepare_queues(cfg, self.workload, gates)
         self._tmpl = (pend, gate, tail)
         self.queue_cap = c
+        self._gate_vid_cap = simm.gates_vid_cap(self.workload, gates)
         round_fn = simm.build_engine(
             cfg, c,
-            vid_cap=simm.gates_vid_cap(self.workload, gates),
+            vid_cap=self._gate_vid_cap,
             runtime_schedule=True,
+            runtime_knobs=True,
         )
-        expected, owner = self.expected, self.owner
+        vid_bound = self.vid_bound
 
-        def lane(root, st, tab):
+        def lane(root, st, tab, kn, exp, own):
             def cond(s):
                 return (~s.done) & (s.t < cfg.max_rounds + tab.horizon)
 
             final = jax.lax.while_loop(
-                cond, lambda s: round_fn(root, s, tab), st
+                cond, lambda s: round_fn(root, s, tab, kn), st
             )
-            return final, vdt.lane_verdict(cfg, final, expected, owner)
+            return final, vdt.lane_verdict(
+                cfg, final, exp, own, vid_cap=vid_bound
+            )
 
         fl = jax.vmap(lane)
         if mesh is not None and mesh.size > 1:
@@ -156,7 +201,7 @@ class FleetRunner:
             spec = P(pmesh.instance_axes(mesh))
             fl = pmesh.shard_map(
                 fl, mesh,
-                in_specs=(spec, spec, spec),
+                in_specs=(spec,) * 6,
                 out_specs=(spec, spec),
             )
         self._fn = jax.jit(fl)
@@ -166,51 +211,162 @@ class FleetRunner:
 
         self._init = jax.jit(jax.vmap(init_lane))
 
+    def _pad_vtab(self, exp: np.ndarray, own: np.ndarray):
+        """Pad a lane's expected/owner arrays to the envelope's table
+        width (-1 expected = vacuous slot; its owner index is unused
+        but must stay in node range for the gather)."""
+        pe = np.full((self.v_cap,), -1, np.int32)
+        po = np.zeros((self.v_cap,), np.int32)
+        pe[: len(exp)] = exp
+        po[: len(own)] = own
+        return pe, po
+
     def _queues(self, n_lanes: int, workloads):
-        """Stacked per-lane (pend, gate, tail).  Per-lane workloads
-        must match the template's shapes (same per-proposer lengths)
-        and its expected-vid set — one verdict bitmap and one compiled
-        queue capacity serve every lane."""
+        """Stacked per-lane (pend, gate, tail, expected, owner) plus
+        the per-lane expected-vid list.  Per-lane workloads must match
+        the template's SHAPES (same per-proposer lengths, same queue
+        capacity) and fit the envelope's vid space — the vid SET and
+        its vid->proposer owner map are runtime verdict tables, free
+        to vary per lane."""
+        def stack(arrays):
+            first = arrays[0]
+            if all(a is first for a in arrays):
+                # identical per-lane arrays (e.g. the search passing
+                # one (workload, gates) pair for every lane): a
+                # broadcast view, not n_lanes materialized copies
+                return np.broadcast_to(first, (n_lanes,) + first.shape)
+            return np.stack(arrays)
+
         if workloads is None:
+            exp_t, own_t = self._pad_vtab(self.expected, self.owner)
             pend, gate, tail = self._tmpl
-            stack = lambda a: np.broadcast_to(a, (n_lanes,) + a.shape)  # noqa: E731
-            return stack(pend), stack(gate), stack(tail)
-        pends, gates_, tails = [], [], []
+            return (
+                stack([pend]), stack([gate]), stack([tail]),
+                stack([exp_t]), stack([own_t]),
+                [self.expected] * n_lanes,
+            )
+        lanes, cache = [], {}
         for wl_lane, g_lane in workloads:
-            exp, own = vdt.expected_owners(self.cfg, wl_lane)
-            if not np.array_equal(exp, self.expected) or not np.array_equal(
-                own, self.owner
-            ):
-                # the owner map is the verdict's crash-excusal key: a
-                # vid owned by a different proposer than the template's
-                # would be excused (or owed) against the wrong node
-                raise ValueError(
-                    "per-lane workload changes the expected-vid set or "
-                    "its vid->proposer owner map; the fleet's coverage "
-                    "verdict is compiled against the template's"
+            key = (id(wl_lane), id(g_lane))
+            if key not in cache:
+                cache[key] = self._lane_tables(wl_lane, g_lane)
+            lanes.append(cache[key])
+        return (
+            stack([ln[0] for ln in lanes]), stack([ln[1] for ln in lanes]),
+            stack([ln[2] for ln in lanes]), stack([ln[3] for ln in lanes]),
+            stack([ln[4] for ln in lanes]), [ln[5] for ln in lanes],
+        )
+
+    def _lane_tables(self, wl_lane, g_lane):
+        """Validate one lane's (workload, gates) against the envelope
+        and return its (pend, gate, tail, expected, owner, exp)."""
+        exp, own = vdt.expected_owners(self.cfg, wl_lane)
+        if exp.size and int(exp.max()) >= self.vid_bound:
+            raise ValueError(
+                f"per-lane workload vid {int(exp.max())} exceeds "
+                f"the envelope's vid bound {self.vid_bound}; build "
+                "the runner with a template covering the vid space"
+            )
+        if len(exp) > self.v_cap:
+            raise ValueError(
+                f"per-lane workload has {len(exp)} distinct vids; "
+                f"the envelope's verdict table holds {self.v_cap}"
+            )
+        if g_lane is not None and self._gate_vid_cap == 0 and any(
+            len(g) and (np.asarray(g) != int(val.NONE)).any()
+            for g in g_lane
+        ):
+            raise ValueError(
+                "per-lane gates need a gate-bearing template: the "
+                "engine compiles gate logic in only when the "
+                "template has gates"
+            )
+        p, g, t, c = simm.prepare_queues(self.cfg, wl_lane, g_lane)
+        if c != self.queue_cap or p.shape != self._tmpl[0].shape:
+            raise ValueError(
+                "per-lane workload shapes must match the template "
+                f"(capacity {c} vs {self.queue_cap})"
+            )
+        pe, po = self._pad_vtab(exp, own)
+        return p, g, t, pe, po, exp
+
+    def _knob_arrays(self, n_lanes: int, knobs):
+        """[lanes]-stacked ``FaultKnobs`` plus the per-lane
+        (schedule-free) FaultConfig list — the shrink hand-off's
+        ``lane_cfg`` source.  ``knobs[i]`` may be a FaultConfig or a
+        host FaultKnobs; None defaults every lane to the base cfg's
+        i.i.d. knobs."""
+        if knobs is None:
+            knobs = [self.cfg.faults] * n_lanes
+        knobs = list(knobs)
+        if len(knobs) != n_lanes:
+            raise ValueError("one knob set per lane required")
+        fcs = []
+        for k in knobs:
+            if isinstance(k, netm.FaultKnobs):
+                # routes through FaultConfig validation (rate ranges,
+                # min <= max)
+                k = FaultConfig(
+                    drop_rate=int(k.drop_rate),
+                    dup_rate=int(k.dup_rate),
+                    min_delay=int(k.min_delay),
+                    max_delay=int(k.max_delay),
+                    crash_rate=int(k.crash_rate),
                 )
-            p, g, t, c = simm.prepare_queues(self.cfg, wl_lane, g_lane)
-            if c != self.queue_cap or p.shape != self._tmpl[0].shape:
-                raise ValueError(
-                    "per-lane workload shapes must match the template "
-                    f"(capacity {c} vs {self.queue_cap})"
+            if not isinstance(k, FaultConfig):
+                raise TypeError(
+                    f"per-lane knobs must be FaultConfig or FaultKnobs, "
+                    f"got {type(k).__name__}"
                 )
-            pends.append(p)
-            gates_.append(g)
-            tails.append(t)
-        return np.stack(pends), np.stack(gates_), np.stack(tails)
+            if k.schedule is not None:
+                raise ValueError(
+                    "per-lane knobs must not carry a schedule; "
+                    "schedules are per-lane runtime tables"
+                )
+            if k.max_delay > self.delay_bound:
+                raise ValueError(
+                    f"lane max_delay {k.max_delay} exceeds the "
+                    f"envelope's ring bound {self.delay_bound} "
+                    "(cfg.faults.max_delay)"
+                )
+            fcs.append(k)
+        stacked = netm.FaultKnobs(
+            drop_rate=np.asarray([fc.drop_rate for fc in fcs], np.int32),
+            dup_rate=np.asarray([fc.dup_rate for fc in fcs], np.int32),
+            min_delay=np.asarray([fc.min_delay for fc in fcs], np.int32),
+            max_delay=np.asarray([fc.max_delay for fc in fcs], np.int32),
+            crash_rate=np.asarray([fc.crash_rate for fc in fcs], np.int32),
+        )
+        return stacked, fcs
 
     def run(
         self,
         seeds,
         schedules,
         workloads=None,
+        knobs=None,
     ) -> FleetReport:
-        """One fleet dispatch: ``seeds[i]`` and ``schedules[i]``
-        (FaultSchedule or None) drive lane ``i``; ``workloads``
-        optionally carries per-lane ``(workload, gates)`` pairs
-        (template-shaped).  Returns once the verdict vector is on the
-        host; the per-lane states stay on device."""
+        """One fleet dispatch: ``seeds[i]``, ``schedules[i]``
+        (FaultSchedule or None), and ``knobs[i]`` (FaultConfig /
+        FaultKnobs or None for the base cfg's mix) drive lane ``i``;
+        ``workloads`` optionally carries per-lane ``(workload,
+        gates)`` pairs (template-shaped; vid sets free within the
+        envelope's vid bound).  Returns once the verdict vector is on
+        the host; the per-lane states stay on device.
+
+        Runners from the envelope cache (``fleet/envelope.runner_for``)
+        REJECT ``workloads=None`` / ``knobs=None``: the cached
+        template's queue order and base knobs belong to whichever
+        caller warmed the cache, so defaulting to them would silently
+        run the wrong faults (the cache normalizes knobs to zero) or
+        the wrong queue order."""
+        if self.explicit_inputs_only and (workloads is None or knobs is None):
+            raise ValueError(
+                "this runner came from the envelope cache "
+                "(fleet/envelope.runner_for): pass explicit workloads= "
+                "and knobs= — its template queues and base knob mix "
+                "are cache-normalized, not yours"
+            )
         seeds = [int(s) for s in seeds]
         schedules = list(schedules)
         n_lanes = len(seeds)
@@ -226,17 +382,24 @@ class FleetRunner:
                 schedules, self.cfg.n_nodes, self.max_episodes
             ),
         )
+        kn, fault_cfgs = self._knob_arrays(n_lanes, knobs)
         roots = jnp.stack([prng.root_key(s) for s in seeds])
-        pend, gate, tail = self._queues(n_lanes, workloads)
-        t0 = time.perf_counter()
+        pend, gate, tail, exp, own, exp_list = self._queues(
+            n_lanes, workloads
+        )
+        t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         with tracecount.engine_scope("fleet"):
             states = self._init(
                 jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail),
                 roots,
             )
-            final, v = self._fn(roots, states, tabs)
+            final, v = self._fn(
+                roots, states, tabs,
+                jax.tree.map(jnp.asarray, kn),
+                jnp.asarray(exp), jnp.asarray(own),
+            )
         verdict = vdt.LaneVerdict(*(np.asarray(x) for x in v))
-        seconds = time.perf_counter() - t0  # verdict transfer = the sync
+        seconds = time.perf_counter() - t0  # verdict transfer = the sync  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         return FleetReport(
             cfg=self.cfg,
             n_lanes=n_lanes,
@@ -246,6 +409,8 @@ class FleetRunner:
             final=final,
             expected=self.expected,
             seconds=seconds,
+            fault_cfgs=fault_cfgs,
+            expected_lanes=exp_list,
         )
 
 
@@ -253,10 +418,12 @@ class FleetRunner:
 
 def audit_entries():
     """Canonical fleet trace (analysis/registry.py): 2 lanes of the
-    audit config geometry with distinct episode mixes through the
-    vmapped while-loop + on-device verdict — the runtime-mask path
-    (masks_at inside the round body) and the verdict reductions are
-    all in the traced program the op budget pins."""
+    audit config geometry with distinct episode mixes AND distinct
+    i.i.d. knob mixes through the vmapped while-loop + on-device
+    verdict — the runtime-mask path (masks_at inside the round body),
+    the runtime-knob sampling, the runtime verdict tables, and the
+    verdict reductions are all in the traced program the op budget
+    pins."""
     from tpu_paxos.analysis.registry import AuditEntry
     from tpu_paxos.core import faults as fltm
     from tpu_paxos.core.sim import audit_canonical_cfg
@@ -266,7 +433,7 @@ def audit_entries():
 
         cfg = dc.replace(
             audit_canonical_cfg(),
-            faults=dc.replace(audit_canonical_cfg().faults, schedule=None),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
         )
         workload = simm.default_workload(cfg)
         runner = FleetRunner(cfg, workload, max_episodes=2)
@@ -280,11 +447,18 @@ def audit_entries():
             jnp.asarray, stm.encode_batch(scheds, cfg.n_nodes, 2)
         )
         roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
-        pend, gate, tail = runner._queues(2, None)
+        kn, _ = runner._knob_arrays(
+            2, [cfg.faults, FaultConfig(dup_rate=1000, max_delay=1)]
+        )
+        pend, gate, tail, exp, own, _ = runner._queues(2, None)
         states = runner._init(
             jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), roots
         )
-        return runner._fn, (roots, states, tabs)
+        return runner._fn, (
+            roots, states, tabs,
+            jax.tree.map(jnp.asarray, kn),
+            jnp.asarray(exp), jnp.asarray(own),
+        )
 
     return [AuditEntry(
         "fleet.run_lanes", build,

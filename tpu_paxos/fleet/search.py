@@ -108,7 +108,7 @@ def search(
     verbose: bool = True,
 ) -> dict:
     """Run the generation loop; returns the JSON-ready summary."""
-    from tpu_paxos.fleet import runner as frun
+    from tpu_paxos.fleet import envelope as env
     from tpu_paxos.harness import shrink as shr
     from tpu_paxos.harness import stress as strs
     from tpu_paxos.utils import log as logm
@@ -127,9 +127,23 @@ def search(
         max_rounds=20_000,
         faults=FaultConfig(**fault_kw),
     )
-    runner = frun.FleetRunner(
-        cfg, workload, gates, mesh=mesh, max_episodes=max_episodes
+    # Shared envelope cache: the search rides the same compiled
+    # executable as the stress sweep's fleet mixes and the shrinker's
+    # candidate evaluations (schedules, knobs, seeds, and workloads
+    # are all runtime inputs; cache users pass workloads explicitly —
+    # the cache does not pin the template's queue order).  The episode
+    # capacity floors at frun.MAX_EPISODES so the shrinker's candidate
+    # evaluator (harness/shrink._runtime_candidate_eval, same floor)
+    # lands on THIS envelope key and reuses the compile — capacity is
+    # decision-log-neutral (unused episode rows are inert).
+    from tpu_paxos.fleet import runner as frun
+
+    runner = env.runner_for(
+        cfg, workload, gates, mesh=mesh,
+        max_episodes=max(max_episodes, frun.MAX_EPISODES),
     )
+    lane_workloads = [(workload, gates)] * n_lanes
+    lane_knobs = [cfg.faults] * n_lanes
     extra = (
         {"decision_round_max": int(decision_round_max)}
         if decision_round_max else {}
@@ -145,7 +159,11 @@ def search(
             for _ in range(n_lanes)
         ]
         seeds = [base_seed + g * n_lanes + i for i in range(n_lanes)]
-        rep = runner.run(seeds, schedules)
+        rep = runner.run(
+            seeds, schedules,
+            workloads=lane_workloads,
+            knobs=lane_knobs,
+        )
         lanes_total += n_lanes
         real_flagged = set(rep.failing)
         flagged = set(real_flagged)
@@ -200,8 +218,9 @@ def search(
                     triage_dir, f"repro_fleet_g{g}_lane{i}.json"
                 )
                 try:
-                    shr.triage(case, path, logger=logger)
+                    art = shr.triage(case, path, logger=logger)
                     wedge["artifact"] = path
+                    wedge["shrink_seconds"] = art.get("shrink_seconds")
                     logger.info("wedge shrunk -> %s", path)
                 except Exception as te:  # triage must never mask a find
                     wedge["triage_error"] = str(te)[:300]

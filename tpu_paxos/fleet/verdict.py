@@ -80,27 +80,39 @@ def lane_verdict(
     final: simm.SimState,
     expected: np.ndarray,
     owner_node: np.ndarray,
+    vid_cap: int | None = None,
 ) -> LaneVerdict:
     """Judge one (unbatched) final engine state on device — the fleet
     runner vmaps this over the lane axis inside the same jit as the
-    round loop, so the verdict costs no extra dispatch."""
+    round loop, so the verdict costs no extra dispatch.
+
+    ``expected``/``owner_node`` may be host numpy (static) or TRACED
+    ``[V]`` arrays — the fleet's per-lane runtime workload tables.
+    Traced callers must pass ``vid_cap`` (the static bitmap bound,
+    the envelope's vid space) and may pad unused slots with ``-1``:
+    padded slots are vacuously covered, so lanes with fewer distinct
+    vids than the envelope's table width judge correctly."""
     learned = final.learned  # [A, I]
     known = learned != val.NONE
     # agreement: every knowing node matches the max over knowing nodes
     best = jnp.max(jnp.where(known, learned, jnp.iinfo(jnp.int32).min), axis=0)
     agreement = ~jnp.any(known & (learned != best[None]))
 
-    # coverage via a chosen-membership bitmap (expected vids are a
-    # static host array, so vid_cap is a static bound)
+    # coverage via a chosen-membership bitmap (vid_cap is the static
+    # bitmap bound; derived here only for concrete host arrays)
     chosen = final.met.chosen_vid  # [I]
-    vid_cap = int(expected.max()) + 1 if expected.size else 1
+    if vid_cap is None:
+        expected = np.asarray(expected)
+        vid_cap = int(expected.max()) + 1 if expected.size else 1
     bitmap = jnp.zeros((vid_cap,), jnp.bool_).at[
         jnp.where(chosen >= 0, chosen, vid_cap)
     ].set(True, mode="drop")
     exp = jnp.asarray(expected, jnp.int32)
     own = jnp.asarray(owner_node, jnp.int32)
-    owner_crashed = final.crashed[own]  # [V]
-    coverage = jnp.all(bitmap[exp] | owner_crashed)
+    valid = exp >= 0  # [V]; False = table padding, vacuously covered
+    owner_crashed = final.crashed[jnp.clip(own, 0, cfg.n_nodes - 1)]  # [V]
+    covered = bitmap[jnp.clip(exp, 0, vid_cap - 1)]
+    coverage = jnp.all(~valid | covered | owner_crashed)
 
     pn = jnp.asarray(cfg.proposers, jnp.int32)
     all_props_crashed = jnp.all(final.crashed[pn])

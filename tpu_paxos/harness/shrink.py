@@ -157,6 +157,55 @@ def check_run(r, cfg: SimConfig, workload, chains) -> None:
     validate_run(r, cfg, workload, chains)
 
 
+def _judge(case: ReproCase, r):
+    """Shared judgment: quiescence + crash-aware suite + artifact-
+    recorded extra checks; returns the violation string or None."""
+    try:
+        check_run(r, case.cfg, case.workload, case.chains)
+        _extra_checks(case, r)
+    except validate.InvariantViolation as e:
+        return str(e)
+    return None
+
+
+def _runtime_candidate_eval(case: ReproCase):
+    """Candidate evaluator on the shared runtime-knob fleet
+    executable (fleet/envelope.py): every shrink move — episode
+    drops, interval bisections, knob zeroings, seed minimization —
+    changes only RUNTIME inputs (the schedule table, the FaultKnobs
+    vector, the PRNG root), so all candidates of a case ride one
+    compile; ``run_case`` recompiles per distinct schedule shape.
+    Decision-log parity (tests/test_knobs.py) makes the two judges
+    agree, and ``save_artifact`` re-verifies the shrunk case on the
+    compile-time path regardless.  Returns ``eval(cand) ->
+    violation-or-None``, or None when the case cannot ride the
+    runtime engine (sharded cases)."""
+    if case.engine != "sim":
+        return None
+    from tpu_paxos.fleet import envelope as env
+    from tpu_paxos.fleet import runner as frun
+
+    sched = case.cfg.faults.schedule
+    max_eps = max(
+        frun.MAX_EPISODES, 0 if sched is None else len(sched.episodes)
+    )
+    runner = env.runner_for(
+        case.cfg, case.workload, case.gates, max_episodes=max_eps
+    )
+
+    def _eval(cand: ReproCase):
+        fc = cand.cfg.faults
+        rep = runner.run(
+            [cand.cfg.seed],
+            [fc.schedule],
+            workloads=[(cand.workload, cand.gates)],
+            knobs=[dataclasses.replace(fc, schedule=None)],
+        )
+        return _judge(cand, rep.lane_result(0))
+
+    return _eval
+
+
 def run_case(case: ReproCase):
     """Execute the case; returns (SimResult, violation-string-or-None)."""
     if case.engine == "sharded":
@@ -176,12 +225,7 @@ def run_case(case: ReproCase):
         )
     else:
         r = simm.run(case.cfg, case.workload, case.gates)
-    try:
-        check_run(r, case.cfg, case.workload, case.chains)
-        _extra_checks(case, r)
-    except validate.InvariantViolation as e:
-        return r, str(e)
-    return r, None
+    return r, _judge(case, r)
 
 
 def decision_log_text(case: ReproCase, r) -> str:
@@ -218,6 +262,12 @@ def shrink_case(
     if viol is None:
         raise ValueError("case does not fail; nothing to shrink")
     budget = _Budget(max_evals)
+    # Candidate evaluation rides the shared runtime-knob executable
+    # when the case can (one compile for the whole greedy descent —
+    # and zero, when the sweep that found the case already compiled
+    # this envelope); run_case stays the judge of record for the
+    # initial failure above and the artifact pin (save_artifact).
+    evaluator = _runtime_candidate_eval(case)
 
     def note(msg):
         if logger is not None:
@@ -226,6 +276,8 @@ def shrink_case(
     def try_case(cand: ReproCase):
         if not budget.spend():
             return None
+        if evaluator is not None:
+            return evaluator(cand)
         _, v = run_case(cand)
         return v
 
@@ -456,6 +508,16 @@ def triage(
     case: ReproCase, out_path: str, max_evals: int = MAX_EVALS, logger=None
 ) -> dict:
     """The sweep's failure hook: shrink the failing case and write its
-    repro artifact.  Returns the artifact dict."""
+    repro artifact.  Returns the artifact dict plus a
+    ``shrink_seconds`` wall-time key (reported in the sweep/search
+    summaries; NOT written to the artifact file, whose schema is
+    closed)."""
+    import time
+
+    t0 = time.perf_counter()  # paxlint: allow[DET001] triage wall-time metric, never serialized into the artifact
     small, viol = shrink_case(case, max_evals=max_evals, logger=logger)
-    return save_artifact(out_path, small, viol)
+    art = save_artifact(out_path, small, viol)
+    seconds = time.perf_counter() - t0  # paxlint: allow[DET001] triage wall-time metric, never serialized into the artifact
+    if logger is not None:
+        logger.info("shrink: wall time %.2fs", seconds)
+    return dict(art, shrink_seconds=round(seconds, 2))

@@ -38,6 +38,7 @@ import zlib
 import jax
 import numpy as np
 
+from tpu_paxos.analysis import tracecount
 from tpu_paxos.config import FaultConfig, SimConfig
 from tpu_paxos.core import faults as flt
 from tpu_paxos.core import sim as simm
@@ -252,8 +253,9 @@ def sweep(
                             cfg=cfg, workload=workload, gates=gates,
                             chains=chains,
                         )
-                        shr.triage(case, path, logger=logger)
+                        art = shr.triage(case, path, logger=logger)
                         failure["artifact"] = path
+                        failure["shrink_seconds"] = art.get("shrink_seconds")
                         logger.error("repro artifact written to %s", path)
                     except Exception as te:  # triage must never mask a failure
                         failure["triage_error"] = str(te)[:300]
@@ -274,6 +276,14 @@ def sweep(
     }
 
 
+# jax.monitoring has no listener-removal API, so every CompileCensus
+# stays registered for the life of the process once started; reuse one
+# module-level census across sweep_fleet calls instead of leaking a
+# deactivated listener per call (compiles_per_mix reads deltas, so
+# counts carried over from earlier sweeps are harmless).
+_fleet_census: tracecount.CompileCensus | None = None
+
+
 def sweep_fleet(
     n_seeds: int = 8,
     base_seed: int = 0,
@@ -283,17 +293,23 @@ def sweep_fleet(
 ) -> dict:
     """The episode-mix sweeps through the FLEET runner: per mix, every
     seed becomes a lane of one device-batched dispatch
-    (fleet/runner.py) — the schedule rides per-lane runtime tables, so
-    a mix compiles once and every seed's whole run happens in a single
-    XLA call.  Lanes are judged on device by the invariant subset
-    (fleet/verdict.py); only failing lanes transfer for the full
-    crash-aware suite + shrink triage.  The host loop (``sweep``)
+    (fleet/runner.py) — the schedule rides per-lane runtime tables
+    and the i.i.d. knobs ride per-lane runtime FaultKnobs, so mixes
+    of one geometry share ONE compiled executable (the envelope cache,
+    fleet/envelope.py: all four episode mixes are 5-node/2-proposer
+    and hit the same envelope) and every seed's whole run happens in a
+    single XLA call.  Lanes are judged on device by the invariant
+    subset (fleet/verdict.py); only failing lanes transfer for the
+    full crash-aware suite + shrink triage.  The host loop (``sweep``)
     stays the fallback and the single-run default.
 
     Each lane is decision-log-identical to the host loop's run of the
     same (mix, seed) — same cfg, workload, and PRNG root — so a lane
-    failure here IS a seed failure there."""
-    from tpu_paxos.fleet import runner as frun
+    failure here IS a seed failure there.  The summary's
+    ``compiles_per_mix`` pins the envelope win: XLA compiles inside
+    each mix's dispatch, counted via ``tracecount.engine_scope`` —
+    after the first mix warms the envelope, subsequent mixes read 0."""
+    from tpu_paxos.fleet import envelope as env
 
     logger = logm.get_logger(
         "stress", logm.parse_level("INFO" if verbose else "WARN")
@@ -301,74 +317,93 @@ def sweep_fleet(
     mixes = EPISODE_MIXES if mixes is None else mixes
     runs, failures = 0, []
     lane_seconds, lanes_total = 0.0, 0
+    compiles_per_mix: dict[str, int] = {}
+    global _fleet_census
+    if _fleet_census is None:
+        _fleet_census = tracecount.CompileCensus()
+    census = _fleet_census.start()
     t0 = time.perf_counter()
-    for label, fkw, n_nodes, n_prop in mixes:
-        sched = fkw["schedule"]
-        base_kw = {k: v for k, v in fkw.items() if k != "schedule"}
-        lanes = []  # (seed, workload, gates, chains)
-        for s in range(n_seeds):
-            seed = base_seed + s
-            rng = np.random.default_rng(
-                seed * 7919 + zlib.crc32(label.encode()) % 1000
-            )
-            workload, gates, chains = _workload(n_prop, rng)
-            lanes.append((seed, workload, gates, chains))
-        cfg = SimConfig(
-            n_nodes=n_nodes,
-            n_instances=2 * sum(len(w) for w in lanes[0][1]),
-            proposers=tuple(range(n_prop)),
-            seed=base_seed,
-            max_rounds=20_000,
-            faults=FaultConfig(**base_kw),
-        )
-        runner = frun.FleetRunner(cfg, lanes[0][1], lanes[0][2])
-        rep = runner.run(
-            [ln[0] for ln in lanes],
-            [sched] * n_seeds,
-            workloads=[(ln[1], ln[2]) for ln in lanes],
-        )
-        runs += n_seeds
-        lanes_total += n_seeds
-        lane_seconds += rep.seconds
-        for i in rep.failing:
-            seed, workload, gates, chains = lanes[i]
-            r = rep.lane_result(i)
-            try:
-                _check_run(r, rep.lane_cfg(i), workload, chains)
-                # device verdict flagged a lane the full suite clears:
-                # a parity/verdict bug — report it as its own failure
-                failures.append({
-                    "mix": label, "seed": seed,
-                    "error": "fleet verdict flagged a lane the full "
-                    "suite clears (verdict/parity drift)",
-                })
-                logger.error(
-                    "FLEET ANOMALY mix=%s seed=%d: verdict red, "
-                    "suite green", label, seed,
+    try:
+        for label, fkw, n_nodes, n_prop in mixes:
+            sched = fkw["schedule"]
+            base_kw = {k: v for k, v in fkw.items() if k != "schedule"}
+            lanes = []  # (seed, workload, gates, chains)
+            for s in range(n_seeds):
+                seed = base_seed + s
+                rng = np.random.default_rng(
+                    seed * 7919 + zlib.crc32(label.encode()) % 1000
                 )
-            except validate.InvariantViolation as e:
-                failure = {"mix": label, "seed": seed, "error": str(e)[:300]}
-                logger.error("FAIL mix=%s seed=%d: %s", label, seed, e)
-                if triage_dir:
-                    os.makedirs(triage_dir, exist_ok=True)
-                    path = os.path.join(
-                        triage_dir, f"repro_{label}_{seed}.json"
+                workload, gates, chains = _workload(n_prop, rng)
+                lanes.append((seed, workload, gates, chains))
+            cfg = SimConfig(
+                n_nodes=n_nodes,
+                n_instances=2 * sum(len(w) for w in lanes[0][1]),
+                proposers=tuple(range(n_prop)),
+                seed=base_seed,
+                max_rounds=20_000,
+                faults=FaultConfig(**base_kw),
+            )
+            runner = env.runner_for(cfg, lanes[0][1], lanes[0][2])
+            before = census.engine_counts.get("fleet", 0)
+            rep = runner.run(
+                [ln[0] for ln in lanes],
+                [sched] * n_seeds,
+                workloads=[(ln[1], ln[2]) for ln in lanes],
+                knobs=[cfg.faults] * n_seeds,
+            )
+            compiles_per_mix[label] = (
+                census.engine_counts.get("fleet", 0) - before
+            )
+            runs += n_seeds
+            lanes_total += n_seeds
+            lane_seconds += rep.seconds
+            for i in rep.failing:
+                seed, workload, gates, chains = lanes[i]
+                r = rep.lane_result(i)
+                try:
+                    _check_run(r, rep.lane_cfg(i), workload, chains)
+                    # device verdict flagged a lane the full suite clears:
+                    # a parity/verdict bug — report it as its own failure
+                    failures.append({
+                        "mix": label, "seed": seed,
+                        "error": "fleet verdict flagged a lane the full "
+                        "suite clears (verdict/parity drift)",
+                    })
+                    logger.error(
+                        "FLEET ANOMALY mix=%s seed=%d: verdict red, "
+                        "suite green", label, seed,
                     )
-                    try:
-                        case = shr.ReproCase(
-                            cfg=rep.lane_cfg(i), workload=workload,
-                            gates=gates, chains=chains,
+                except validate.InvariantViolation as e:
+                    failure = {"mix": label, "seed": seed, "error": str(e)[:300]}
+                    logger.error("FAIL mix=%s seed=%d: %s", label, seed, e)
+                    if triage_dir:
+                        os.makedirs(triage_dir, exist_ok=True)
+                        path = os.path.join(
+                            triage_dir, f"repro_{label}_{seed}.json"
                         )
-                        shr.triage(case, path, logger=logger)
-                        failure["artifact"] = path
-                        logger.error("repro artifact written to %s", path)
-                    except Exception as te:
-                        failure["triage_error"] = str(te)[:300]
-                failures.append(failure)
-        logger.info(
-            "fleet mix %-14s: %d lanes in %.2fs (%.1f lanes/sec)",
-            label, n_seeds, rep.seconds, rep.lanes_per_sec,
-        )
+                        try:
+                            case = shr.ReproCase(
+                                cfg=rep.lane_cfg(i), workload=workload,
+                                gates=gates, chains=chains,
+                            )
+                            art = shr.triage(case, path, logger=logger)
+                            failure["artifact"] = path
+                            failure["shrink_seconds"] = art.get("shrink_seconds")
+                            logger.error("repro artifact written to %s", path)
+                        except Exception as te:
+                            failure["triage_error"] = str(te)[:300]
+                    failures.append(failure)
+            logger.info(
+                "fleet mix %-14s: %d lanes in %.2fs (%.1f lanes/sec, "
+                "%d compiles)",
+                label, n_seeds, rep.seconds, rep.lanes_per_sec,
+                compiles_per_mix[label],
+            )
+    finally:
+        # jax.monitoring has no listener-removal API, so an
+        # abandoned census would keep counting every later
+        # compile in the process — stop() must run on all paths
+        census.stop()
     return {
         "metric": "stress_sweep_fleet",
         "runs": runs,
@@ -376,6 +411,7 @@ def sweep_fleet(
         "seeds_per_mix": n_seeds,
         "lanes": lanes_total,
         "lanes_per_sec": round(lanes_total / max(lane_seconds, 1e-9), 2),
+        "compiles_per_mix": compiles_per_mix,
         "failures": failures,
         "ok": not failures,
         "seconds": round(time.perf_counter() - t0, 1),
