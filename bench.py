@@ -543,6 +543,23 @@ def bench_fleet_record() -> dict:
         )
         delay_dts.append(rep.seconds)
         delay_rounds_max = max(delay_rounds_max, int(rep.verdict.rounds.max()))
+    # flight-recorder overhead: the telemetry-armed twin of the same
+    # envelope (telemetry/recorder.py rides the lane carry, summaries
+    # reduced on device) on the headline mix — same seeds, schedules,
+    # and knobs, so the delta IS the recorder.  Its own compile (the
+    # armed engine is a different traced program) stays outside the
+    # timed range, like the cold dispatch above.
+    trunner = frun.FleetRunner(cfg, workload, gates, telemetry=True)
+    trunner.run(
+        [10_000 + i for i in range(n_lanes)], schedules, knobs=lane_knobs
+    )
+    tele_dts = []
+    for k in range(3):
+        rep = trunner.run(
+            [k * n_lanes + i for i in range(n_lanes)], schedules,
+            knobs=lane_knobs,
+        )
+        tele_dts.append(rep.seconds)
     config = {
         "n_nodes": cfg.n_nodes,
         "n_instances": cfg.n_instances,
@@ -560,6 +577,13 @@ def bench_fleet_record() -> dict:
             n_lanes / max(max(delay_dts), 1e-9), 2
         ),
         "delay_spread_rounds_max": delay_rounds_max,
+        "telemetry_raw_s": [round(x, 4) for x in sorted(tele_dts)],
+        # same median-of-3 convention as the recorder-free headline,
+        # so (value - telemetry_lanes_per_sec) reads as the
+        # recorder's whole cost
+        "telemetry_lanes_per_sec": round(
+            n_lanes / max(sorted(tele_dts)[1], 1e-9), 2
+        ),
         "red_lanes_warmup": n_red_warm,
         "devices": 1,
         "platform": jax.devices()[0].platform,

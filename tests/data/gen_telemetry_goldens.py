@@ -1,0 +1,44 @@
+"""Re-pin the flight-recorder goldens (run from the repo root):
+
+    JAX_PLATFORMS=cpu python tests/data/gen_telemetry_goldens.py
+
+Writes tests/data/stress_telemetry_golden.json (the sweep_fleet
+per-mix telemetry block for EPISODE_MIXES[0], 2 seeds — the
+test_stress_fleet_telemetry_golden shape) and
+tests/data/trace_golden.json (the trace CLI's Chrome-trace JSON for
+the committed fleet-quick wedge artifact).  Both are pure functions
+of the determinism contract; re-pin only for deliberate recorder,
+engine, or mix changes."""
+
+import json
+import os
+
+DATA = os.path.dirname(os.path.abspath(__file__))
+WEDGE_ARTIFACT = "stress-triage/repro_fleet_g0_lane0.json"
+
+
+def main():
+    os.environ.setdefault("TPU_PAXOS_DETERMINISTIC", "1")
+    from tpu_paxos.harness import stress
+    from tpu_paxos.telemetry import export as texport
+
+    summary = stress.sweep_fleet(
+        n_seeds=2, verbose=False, mixes=stress.EPISODE_MIXES[:1]
+    )
+    assert summary["ok"], summary["failures"]
+    out = os.path.join(DATA, "stress_telemetry_golden.json")
+    with open(out, "w") as f:
+        json.dump(summary["telemetry"], f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("wrote", out)
+
+    trace = texport.trace_artifact(WEDGE_ARTIFACT)
+    out = os.path.join(DATA, "trace_golden.json")
+    with open(out, "w") as f:
+        json.dump(trace, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -293,3 +293,26 @@ def test_deep_copy_safety():
     snapshot = copy.deepcopy(art)
     validate_artifact(art)
     assert art == snapshot
+
+
+def test_telemetry_never_leaks_into_artifacts():
+    """The flight recorder is recomputed at replay (``python -m
+    tpu_paxos trace``), NEVER stored: the artifact format stamp and
+    the declared schema key set are pinned at their pre-telemetry
+    values, and the committed fleet-quick wedge artifact — the real
+    producer's output — carries no keys outside the declared set."""
+    from tpu_paxos.analysis.artifact_schema import ARTIFACT_SCHEMA
+
+    assert ARTIFACT_FORMAT == "tpu-paxos-repro-1"
+    assert set(ARTIFACT_SCHEMA.props) == {
+        "format", "engine", "devices", "cfg", "workload", "gates",
+        "chains", "extra_checks", "violation", "decision_log_sha256",
+        "rounds",
+    }, "artifact schema grew a field — telemetry must stay recomputed"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    wedge = os.path.join(repo, "stress-triage",
+                         "repro_fleet_g0_lane0.json")
+    art = json.load(open(wedge))
+    assert set(art) <= set(ARTIFACT_SCHEMA.props), sorted(
+        set(art) - set(ARTIFACT_SCHEMA.props)
+    )

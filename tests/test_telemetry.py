@@ -1,0 +1,400 @@
+"""The flight recorder (tpu_paxos/telemetry/): decision-log
+neutrality, summary correctness, and the Chrome-trace exporter.
+
+The load-bearing contract is NEUTRALITY: a telemetry-armed engine must
+be decision-log sha256-identical to the plain one for the same (cfg,
+schedule, seed) — the recorder consumes no PRNG streams and never
+feeds back into ``SimState``.  Pinned here for the general engine's
+compile-time path (fast tier) and for fleet lanes — which ARE the
+runtime-knob/runtime-schedule path — over a 5-node crash+pause grid
+cell (slow tier, it compiles two fleet envelopes).
+
+The stress telemetry block and the trace CLI are golden-JSON pinned
+like the paxlint/audit reports: the JSON shape is an interface, so
+drift must be deliberate enough to update tests/data/."""
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import sim as simm
+from tpu_paxos.core import values as val
+from tpu_paxos.replay.decision_log import decision_log
+from tpu_paxos.telemetry import export as texport
+from tpu_paxos.telemetry import recorder as telem
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+WEDGE_ARTIFACT = "stress-triage/repro_fleet_g0_lane0.json"
+
+WL = [np.arange(100, 108, dtype=np.int32),
+      np.arange(200, 208, dtype=np.int32)]
+
+SMALL_SCHED = flt.FaultSchedule((
+    flt.partition(2, 10, (0,), (1, 2)),
+    flt.pause(3, 8, 2),
+    flt.burst(4, 9, 1500),
+))
+
+
+def _log_sha(r):
+    stride = int(max(int(np.max(w)) for w in WL)) + 1
+    text = decision_log(
+        r.chosen_vid, r.chosen_ballot, stride=stride,
+        n_instances=len(r.chosen_vid),
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+# ---------------- host-side reducers (no jax) ----------------
+
+
+def test_latency_quantile():
+    # nothing decided
+    assert telem.latency_quantile(np.zeros(10, np.int32), 0.99, -1) == -1
+    # all latencies in one bucket: the bucket edge, clamped to the max
+    h = np.zeros(10, np.int32)
+    h[3] = 8  # bucket (4, 8]
+    assert telem.latency_quantile(h, 0.50, 7) == 7  # clamp: edge 8 > max 7
+    assert telem.latency_quantile(h, 0.99, 8) == 8
+    # split across buckets: the quantile walks the cumulative counts
+    h = np.zeros(10, np.int32)
+    h[1], h[3] = 8, 8  # (1,2] and (4,8]
+    assert telem.latency_quantile(h, 0.50, 5) == 2
+    assert telem.latency_quantile(h, 0.99, 5) == 5
+    # overflow bucket reports the exact observed max
+    h = np.zeros(10, np.int32)
+    h[-1] = 4
+    assert telem.latency_quantile(h, 0.99, 413) == 413
+    # p50 <= p99 <= max always holds (the clamp)
+    for m in (1, 3, 40, 1000):
+        hist = np.asarray([0, 3, 1, 0, 2, 0, 0, 0, 0, 1], np.int32)
+        p50 = telem.latency_quantile(hist, 0.50, m)
+        p99 = telem.latency_quantile(hist, 0.99, m)
+        assert p50 <= p99 <= m
+
+
+def _mk_summary(**over):
+    """A host-numpy TelemetrySummary with recognizable values."""
+    base = dict(
+        msgs=np.arange(7, dtype=np.int32),
+        offered=np.full(7, 100, np.int32),
+        dropped=np.full(7, 5, np.int32),
+        duped=np.full(7, 2, np.int32),
+        delayed=np.full(7, 3, np.int32),
+        learns=np.int32(48),
+        commit_acks=np.int32(9),
+        takeovers=np.int32(1),
+        requeues=np.int32(4),
+        restarts=np.int32(2),
+        decided=np.int32(16),
+        lat_hist=np.asarray([0, 8, 0, 8, 0, 0, 0, 0, 0, 0], np.int32),
+        lat_max=np.int32(5),
+        heal_gap=np.int32(24),
+        stall_max=np.int32(3),
+        duel_max=np.int32(4),
+        takeover_round=np.asarray([7, -1], np.int32),
+        rounds=np.int32(34),
+        quiescent=np.bool_(True),
+    )
+    base.update(over)
+    return telem.TelemetrySummary(**base)
+
+
+def test_summary_to_dict():
+    d = telem.summary_to_dict(_mk_summary())
+    assert set(d["msgs"]) == set(telem.MSG_NAMES)
+    assert d["offered_total"] == 700
+    assert d["dropped_total"] == 35
+    assert d["drop_rate_observed"] == 500.0  # 35/700 in per-1e4 units
+    assert d["latency_p50"] == 2 and d["latency_p99"] == 5
+    assert d["latency_hist"] == [0, 8, 0, 8, 0, 0, 0, 0, 0, 0]
+    assert d["takeover_round"] == [7, -1]
+    assert d["heal_gap"] == 24 and d["quiescent"] is True
+    # zero offered edges: the observed rate is 0.0, not a div-by-zero
+    z = telem.summary_to_dict(_mk_summary(
+        offered=np.zeros(7, np.int32), dropped=np.zeros(7, np.int32)
+    ))
+    assert z["drop_rate_observed"] == 0.0
+    m = telem.margins_vector(_mk_summary())
+    assert m == {"heal_gap": 24, "stall_max": 3, "duel_max": 4,
+                 "rounds": 34, "latency_max": 5}
+
+
+def _stack(summaries):
+    """[lanes]-stack host summaries the way a FleetReport carries
+    them."""
+    import jax
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *summaries)
+
+
+def test_lane_reducers_on_crafted_lanes():
+    """The stress mix block and the search's generation margins reduce
+    [lanes] summaries; -1 heal gaps (never quiesced) are excluded from
+    the min, and the margin vector takes the across-lane extremes."""
+    from tpu_paxos.fleet import search as fsearch
+    from tpu_paxos.harness import stress
+
+    lanes = _stack([
+        _mk_summary(),
+        _mk_summary(heal_gap=np.int32(-1), stall_max=np.int32(9),
+                    lat_max=np.int32(7), duel_max=np.int32(2),
+                    rounds=np.int32(500), quiescent=np.bool_(False)),
+        _mk_summary(heal_gap=np.int32(3)),
+    ])
+    rep = types.SimpleNamespace(telemetry=lanes)
+    cfg = SimConfig(n_nodes=3, proposers=(0, 1), n_instances=16,
+                    faults=FaultConfig(drop_rate=450))
+    blk = stress._mix_telemetry(rep, cfg)
+    assert blk["offered"] == 2100 and blk["dropped"] == 105
+    assert blk["drop_rate_configured"] == 450
+    assert blk["drop_rate_observed"] == 500.0
+    assert blk["heal_gap_min"] == 3  # the -1 lane is excluded
+    assert blk["stall_depth_max"] == 9
+    assert blk["decided"] == 48 and blk["takeovers"] == 3
+    mar = fsearch._generation_margins(rep)
+    assert mar["heal_gap_min"] == 3
+    assert mar["stall_depth_max"] == 9
+    assert mar["duel_depth_max"] == 4
+    assert mar["rounds_max"] == 500
+    assert mar["latency_max"] == 7
+    # recorder-free reports reduce to empty blocks, not crashes
+    bare = types.SimpleNamespace(telemetry=None)
+    assert stress._mix_telemetry(bare, cfg) == {}
+    assert fsearch._generation_margins(bare) == {}
+
+
+# ---------------- the exporter (host-side, crafted run) ----------------
+
+
+def _crafted_trace():
+    sched = flt.FaultSchedule((
+        # nodes 1, 2 unlisted: they form the implicit second group
+        # (core/faults.partition) and must render a bar too
+        flt.partition(2, 6, (0,)),
+        flt.one_way(3, 7, (1,), (2,)),
+        flt.pause(4, 8, 2),
+        flt.burst(5, 9, 2000),
+    ))
+    cfg = SimConfig(n_nodes=3, proposers=(0, 1), n_instances=4,
+                    faults=FaultConfig(schedule=sched))
+    result = types.SimpleNamespace(
+        chosen_vid=np.asarray([100, int(val.NONE), 200, 101], np.int32),
+        chosen_round=np.asarray([5, -1, 5, 9], np.int32),
+        chosen_ballot=np.asarray([1, -1, 2, 1], np.int32),
+        rounds=11, done=True,
+    )
+    sd = telem.summary_to_dict(_mk_summary(
+        takeover_round=np.asarray([-1, 6], np.int32)
+    ))
+    return texport.chrome_trace(cfg, result, sd, label="crafted")
+
+
+def test_chrome_trace_structure():
+    trace = _crafted_trace()
+    evs = trace["traceEvents"]
+    assert all(
+        {"ph", "name", "pid", "tid", "ts"} <= set(e) for e in evs
+    )
+    # every episode kind renders as a complete-duration event
+    dur = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert "partition side 0" in dur and "pause" in dur
+    assert any(n.startswith("one_way") for n in dur)
+    assert any(n.startswith("burst") for n in dur)
+    # the implicit partition side (unlisted nodes 1, 2) renders bars
+    side1 = [e for e in evs if e["name"] == "partition side 1"]
+    assert sorted(e["pid"] for e in side1) == [1, 2]
+    p = dur["pause"]
+    assert p["pid"] == 2 and p["ts"] == 4000 and p["dur"] == 4000
+    # decisions: one instant per decided instance, round-ordered
+    dec = [e for e in evs if e["ph"] == "i" and e["name"].startswith("dec")]
+    assert len(dec) == 3
+    assert [e["args"]["round"] for e in dec] == [5, 5, 9]
+    # the takeover instant lands on the adopting proposer's node track
+    tk = [e for e in evs if e["name"] == "commit takeover"]
+    assert len(tk) == 1 and tk[0]["pid"] == 1 and tk[0]["ts"] == 6000
+    # counter track is cumulative
+    cts = [e for e in evs if e["ph"] == "C"]
+    assert [c["args"]["instances"] for c in cts] == [2, 3]
+    other = trace["otherData"]
+    assert other["decided"] == 3 and other["rounds"] == 11
+    assert other["telemetry"]["takeover_round"] == [-1, 6]
+    # recorder-free renders (sharded replays): no telemetry block, no
+    # takeover instants (the recorder is their only source)
+    sched = flt.FaultSchedule((flt.pause(4, 8, 2),))
+    cfg = SimConfig(n_nodes=3, proposers=(0, 1), n_instances=4,
+                    faults=FaultConfig(schedule=sched))
+    result = types.SimpleNamespace(
+        chosen_vid=np.asarray([100, 200, int(val.NONE), 101], np.int32),
+        chosen_round=np.asarray([5, 5, -1, 9], np.int32),
+        chosen_ballot=np.asarray([1, 2, -1, 1], np.int32),
+        rounds=11, done=True,
+    )
+    bare = texport.chrome_trace(cfg, result, None)
+    assert "telemetry" not in bare["otherData"]
+    assert not [e for e in bare["traceEvents"]
+                if e["name"] == "commit takeover"]
+    assert [e for e in bare["traceEvents"] if e["ph"] == "X"]
+
+
+# ---------------- neutrality: the general engine (fast tier) ----------------
+
+
+def test_single_run_recorder_parity():
+    """run() vs run_with_telemetry(): identical decision logs and
+    result arrays for a schedule + i.i.d.-knob mix on the compile-time
+    path, and the summary's invariants hold against the result."""
+    cfg = SimConfig(
+        n_nodes=3, proposers=(0, 1), n_instances=32, seed=3,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2,
+                           crash_rate=1000, schedule=SMALL_SCHED),
+    )
+    a = simm.run(cfg, WL)
+    b, summ = simm.run_with_telemetry(cfg, WL)
+    assert _log_sha(a) == _log_sha(b)
+    assert (np.asarray(a.chosen_vid) == np.asarray(b.chosen_vid)).all()
+    assert (np.asarray(a.chosen_round) == np.asarray(b.chosen_round)).all()
+    assert (np.asarray(a.learned) == np.asarray(b.learned)).all()
+    assert (np.asarray(a.crashed) == np.asarray(b.crashed)).all()
+    assert a.rounds == b.rounds and a.done == b.done
+    # summary sanity against the result it rode along with
+    assert (np.asarray(summ.msgs) == np.asarray(a.msgs)).all()
+    assert int(summ.rounds) == a.rounds
+    assert bool(summ.quiescent) == a.done
+    decided = int((np.asarray(a.chosen_vid) != int(val.NONE)).sum())
+    assert int(summ.decided) == decided
+    hist = np.asarray(summ.lat_hist)
+    assert 0 < hist.sum() <= decided
+    # offered edges bound the per-type fault-layer counters
+    assert (np.asarray(summ.dropped) <= np.asarray(summ.offered)).all()
+    assert (np.asarray(summ.delayed) <= np.asarray(summ.offered)).all()
+    # the schedule healed and the run quiesced: the gap is the
+    # liveness margin, positive and round-bounded
+    assert 0 <= int(summ.heal_gap) <= a.rounds
+    assert int(summ.lat_max) >= 1
+    d = telem.summary_to_dict(summ)
+    assert d["latency_p50"] <= d["latency_p99"] <= d["latency_max"]
+
+
+def test_engine_flag_validation():
+    cfg = SimConfig(n_nodes=3, proposers=(0, 1), n_instances=16)
+    pend, gate, tail, c = simm.prepare_queues(cfg, WL)
+    with pytest.raises(ValueError, match="sharded"):
+        simm.build_engine(cfg, c, vid_cap=0, telemetry=True,
+                          axis_name="i")
+    rf = simm.build_engine(cfg, c, vid_cap=0, telemetry=True)
+    from tpu_paxos.utils import prng
+
+    root = prng.root_key(0)
+    st = simm.init_state(cfg, pend, gate, tail, root)
+    with pytest.raises(TypeError, match="Telemetry"):
+        rf(root, st)
+
+
+# ---------------- neutrality: fleet lanes / runtime knobs (slow) ----------------
+
+
+@pytest.mark.slow
+def test_fleet_recorder_parity_grid():
+    """Recorder on/off sha256 parity where it costs the most: 5-node
+    fleet lanes under a partition+pause+burst schedule with
+    drop/dup/delay/crash knobs — the runtime-knob path — plus the
+    single-run telemetry engine, all four decision-log-identical; and
+    the fleet lane's reduced summary equals the single-run summary
+    field-for-field (the vmap changes nothing)."""
+    from tpu_paxos.fleet import envelope as env
+
+    sched = flt.FaultSchedule((
+        flt.partition(4, 16, (0, 1), (2, 3, 4)),
+        flt.pause(6, 14, 2),
+        flt.burst(5, 12, 1500),
+    ))
+    cfg = SimConfig(
+        n_nodes=5, n_instances=48, proposers=(0, 1), seed=3,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2,
+                           crash_rate=3000, schedule=sched),
+    )
+    fc = cfg.faults
+    a = simm.run(cfg, WL)
+    b, summ = simm.run_with_telemetry(cfg, WL)
+    r_plain = env.runner_for(cfg, WL)
+    r_tel = env.runner_for(cfg, WL, telemetry=True)
+    assert r_tel is not r_plain  # the armed twin is its own envelope
+    kw = dict(workloads=[(WL, None)] * 2,
+              knobs=[dataclasses.replace(fc, schedule=None)] * 2)
+    rp = r_plain.run([3, 4], [sched] * 2, **kw)
+    rt = r_tel.run([3, 4], [sched] * 2, **kw)
+    shas = {_log_sha(a), _log_sha(b),
+            _log_sha(rp.lane_result(0)), _log_sha(rt.lane_result(0))}
+    assert len(shas) == 1, "recorder or vmap changed the decision log"
+    # lane 1 (different seed) agrees between armed and plain fleets
+    assert _log_sha(rp.lane_result(1)) == _log_sha(rt.lane_result(1))
+    assert rp.verdict.ok.all() and rt.verdict.ok.all()
+    # the fleet's reduced lane summary IS the single-run summary
+    assert rp.lane_telemetry(0) is None
+    assert rt.lane_telemetry(0) == telem.summary_to_dict(summ)
+
+
+@pytest.mark.slow
+def test_stress_fleet_telemetry_golden(monkeypatch):
+    """The stress sweep's per-mix telemetry block, golden-pinned: the
+    block is a pure function of (cfg, seeds) — no wall clock — so any
+    drift is a real behaviour change (recorder semantics, engine
+    decision path, or mix definition) and must update the golden."""
+    from tpu_paxos.harness import stress
+
+    summary = stress.sweep_fleet(
+        n_seeds=2, verbose=False, mixes=stress.EPISODE_MIXES[:1]
+    )
+    assert summary["ok"], summary["failures"]
+    got = summary["telemetry"]
+    path = os.path.join(DATA, "stress_telemetry_golden.json")
+    want = json.load(open(path))
+    assert got == want, (
+        "stress telemetry block drifted from tests/data/"
+        "stress_telemetry_golden.json — if deliberate, re-pin with "
+        "tests/data/gen_telemetry_goldens.py"
+    )
+    blk = got["partition-flap"]
+    assert blk["offered"] > 0
+    assert blk["latency_p50"] <= blk["latency_p99"] <= blk["latency_max"]
+
+
+@pytest.mark.slow
+def test_trace_cli_golden():
+    """``python -m tpu_paxos trace`` on the committed fleet-quick
+    wedge artifact emits the exact golden Chrome-trace JSON (telemetry
+    recomputed at replay; artifact untouched), exit 0."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    try:
+        from _subproc import scrubbed_env
+        envv = scrubbed_env()
+    finally:
+        sys.path.pop(0)
+    envv["JAX_PLATFORMS"] = "cpu"
+    before = open(os.path.join(REPO, WEDGE_ARTIFACT), "rb").read()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_paxos", "trace", WEDGE_ARTIFACT,
+         "--stdout"],
+        cwd=REPO, env=envv, capture_output=True, text=True, timeout=500,
+    )
+    assert proc.returncode == 0, proc.stderr
+    got = json.loads(proc.stdout)
+    want = json.load(open(os.path.join(DATA, "trace_golden.json")))
+    assert got == want, (
+        "trace JSON drifted from tests/data/trace_golden.json — if "
+        "deliberate, re-pin with tests/data/gen_telemetry_goldens.py"
+    )
+    assert open(os.path.join(REPO, WEDGE_ARTIFACT), "rb").read() == before

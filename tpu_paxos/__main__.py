@@ -20,6 +20,10 @@ stress sweep (harness/shrink.py), prints the decision log, and exits
 0 iff the recorded violation recurs with a byte-identical decision
 log (sha256 compare — the member/diff.sh workflow for the general
 engine).
+
+``python -m tpu_paxos trace <artifact.json>`` renders the same
+artifact as a Chrome-trace/Perfetto timeline instead (flight-recorder
+telemetry recomputed at replay; telemetry/export.py).
 """
 
 from __future__ import annotations
@@ -549,6 +553,12 @@ def main(argv=None) -> int:
         # subcommand form: the positional grammar below is the
         # reference CLI's (srvcnt cltcnt idcnt); repro takes a path
         return run_repro(argv[1:])
+    if argv and argv[0] == "trace":
+        # observability: render a repro artifact as a Chrome-trace/
+        # Perfetto timeline (telemetry recomputed at replay)
+        from tpu_paxos.telemetry import export as texport
+
+        return texport.main(argv[1:])
     if argv and argv[0] == "fleet":
         # device-batched schedule search: (seed x schedule) lanes per
         # XLA dispatch, wedges shrunk to repro artifacts

@@ -387,10 +387,22 @@ def build_engine(
     use_pallas: bool | None = None,
     runtime_schedule: bool = False,
     runtime_knobs: bool = False,
+    telemetry: bool = False,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
     the state; everything shape-like is baked in.
+
+    With ``telemetry=True`` the flight recorder
+    (telemetry/recorder.py) rides the loop carry: ``round_fn(...,
+    tele=Telemetry)`` returns ``(state, telemetry)``, with every
+    recorder field computed from values the round already produced —
+    the recorder consumes NO PRNG streams and never feeds back into
+    the state, so the armed engine is decision-log-identical to the
+    plain one (sha256 parity pinned by tests/test_telemetry.py) and
+    ``telemetry=False`` traces the exact pre-recorder program.
+    Unsupported together with ``axis_name`` (the sharded path keeps
+    its per-shard state replication argument recorder-free for now).
 
     With ``runtime_knobs=True`` the i.i.d. fault knobs are NOT baked
     in either: ``round_fn(root, state, tab, knobs)`` takes a traced
@@ -454,6 +466,13 @@ def build_engine(
             "runtime_schedule engines take their schedule per call "
             "(ScheduleTable); cfg.faults.schedule must be None"
         )
+    if telemetry and axis_name is not None:
+        raise ValueError(
+            "telemetry is not supported on the sharded engine yet "
+            "(the recorder's per-instance ledger is unsharded)"
+        )
+    if telemetry:
+        from tpu_paxos.telemetry import recorder as _rec
     if runtime_schedule:
         from tpu_paxos.fleet import schedule_table as _stm
     # Correlated-fault schedule, lowered to dense per-round tables and
@@ -528,7 +547,9 @@ def build_engine(
     def rany(b):
         return jnp.any(b)
 
-    def round_fn(root: jax.Array, st: SimState, tab=None, knobs=None) -> SimState:
+    def round_fn(
+        root: jax.Array, st: SimState, tab=None, knobs=None, tele=None
+    ):
         if runtime_schedule and tab is None:
             raise TypeError(
                 "this engine was built with runtime_schedule=True; "
@@ -538,6 +559,11 @@ def build_engine(
             raise TypeError(
                 "this engine was built with runtime_knobs=True; "
                 "round_fn needs a FaultKnobs argument"
+            )
+        if telemetry and tele is None:
+            raise TypeError(
+                "this engine was built with telemetry=True; round_fn "
+                "needs a Telemetry accumulator argument"
             )
         # queue rows must be pre-padded by the window width (see
         # prepare_queues) so window ops are copy-free dynamic slices.
@@ -1076,6 +1102,13 @@ def build_engine(
         met = st.met._replace(
             chosen_vid=mvid, chosen_round=mround, chosen_ballot=mballot
         )
+        if telemetry:
+            # Latency-ledger admission: the first round each instance
+            # carried a value in an accept batch, captured BEFORE the
+            # mode-ladder clears below — this is the batch the ack
+            # accumulation above judged, so admission always precedes
+            # (or equals) the instance's decision round.
+            _adm_any = jnp.any(cur_batch != val.NONE, axis=0)  # [I]
 
         # COMMIT sends: newly chosen + deadline resends of batches not
         # yet acked by every live node (ref :1625-1641 retries until
@@ -1435,65 +1468,78 @@ def build_engine(
         # Every send mask passes through the schedule's reachability
         # cut (_cut_pa/_cut_ap); burst windows ride copy_plan's
         # extra_drop (_plan).  Message counters below stay pre-fault.
+        # With telemetry armed, each site's (copy plan, post-cut mask)
+        # pair also feeds the recorder's fault-layer counters
+        # (_tsites) — reading values already computed, never sampling.
         edge_pa = (p, a)
+        _tsites = []  # [(alive, delay, post-cut mask)] in MSG order
         # prepare requests
         al, dl = _plan(keys[0], edge_pa)
+        m_prep = _cut_pa(send_prep[:, None] & jnp.ones((p, a), jnp.bool_))
+        _tsites.append((al, dl, m_prep))
         net = net._replace(
             prep_req=netm.write_ballot(
-                net.prep_req, t, al, dl, ballot[:, None],
-                _cut_pa(send_prep[:, None] & jnp.ones((p, a), jnp.bool_)),
+                net.prep_req, t, al, dl, ballot[:, None], m_prep
             )
         )
         # prepare replies (granted only; snapshot read at delivery)
         al, dl = _plan(keys[1], (a, p))
         send_rep = grant.T  # [A, P]
         echo_val = preq.T  # [A, P] the granted ballot
+        m_rep = _cut_ap(send_rep)
+        _tsites.append((al, dl, m_rep))
         net = net._replace(
             prep_echo=netm.write_ballot(
-                net.prep_echo, t, al, dl, echo_val, _cut_ap(send_rep)
+                net.prep_echo, t, al, dl, echo_val, m_rep
             )
         )
         # rejects (both phases share one message, ref MSG_REJECT)
         al, dl = _plan(keys[2], (a, p))
         send_rej = (rej_prep | rej_acc).T
+        m_rej = _cut_ap(send_rej)
+        _tsites.append((al, dl, m_rej))
         net = net._replace(
             rej=netm.write_ballot(
                 net.rej, t, al, dl,
                 jnp.broadcast_to(max_seen[:, None], (a, p)),
-                _cut_ap(send_rej),
+                m_rej,
             )
         )
         # accepts: per-edge ballot (batch content read at delivery)
         al, dl = _plan(keys[3], edge_pa)
+        m_acc = _cut_pa(send_accept[:, None] & jnp.ones((p, a), jnp.bool_))
+        _tsites.append((al, dl, m_acc))
         net = net._replace(
             acc_req=netm.write_ballot(
-                net.acc_req, t, al, dl, ballot[:, None],
-                _cut_pa(send_accept[:, None] & jnp.ones((p, a), jnp.bool_)),
+                net.acc_req, t, al, dl, ballot[:, None], m_acc
             )
         )
         # accept replies (ack rows derived at delivery)
         al, dl = _plan(keys[4], (a, p))
         send_arep = elig.T  # [A, P] reply whenever ballot >= promised
         aecho_val = jnp.broadcast_to(abal[None, :], (a, p))
+        m_arep = _cut_ap(send_arep)
+        _tsites.append((al, dl, m_arep))
         net = net._replace(
             acc_echo=netm.write_ballot(
-                net.acc_echo, t, al, dl, aecho_val, _cut_ap(send_arep)
+                net.acc_echo, t, al, dl, aecho_val, m_arep
             )
         )
         # commits: per-edge presence (content read at delivery from
         # the sender's write-once commit_vid)
         al, dl = _plan(keys[5], edge_pa)
+        m_com = _cut_pa(send_commit[:, None] & jnp.ones((p, a), jnp.bool_))
+        _tsites.append((al, dl, m_com))
         net = net._replace(
-            com_pres=netm.write_flag(
-                net.com_pres, t, al, dl,
-                _cut_pa(send_commit[:, None] & jnp.ones((p, a), jnp.bool_)),
-            )
+            com_pres=netm.write_flag(net.com_pres, t, al, dl, m_com)
         )
         # commit replies: presence; ack-by-learned-match at delivery
         al, dl = _plan(keys[6], (a, p))
         send_crep = cpres.T  # [A, P]
+        m_crep = _cut_ap(send_crep)
+        _tsites.append((al, dl, m_crep))
         net = net._replace(
-            com_rep=netm.write_flag(net.com_rep, t, al, dl, _cut_ap(send_crep))
+            com_rep=netm.write_flag(net.com_rep, t, al, dl, m_crep)
         )
 
         # message counters (logical sends, pre-fault)
@@ -1621,7 +1667,7 @@ def build_engine(
         )
         stall = jnp.where(idle_now & unresolved & ~done, pr.stall + 1, 0)
 
-        return SimState(
+        new_st = SimState(
             t=t + 1,
             acc=acc,
             learned=learned,
@@ -1660,6 +1706,40 @@ def build_engine(
             qsums=sums,
             qhmax=hmax,
         )
+        if not telemetry:
+            return new_st
+        # ---------------- flight recorder (read-only) ----------------
+        # Every field below reduces values the round already computed;
+        # nothing here samples PRNG streams or writes back into the
+        # state, so the armed engine stays decision-log-identical.
+        tc = [_rec.count_copies(al_, dl_, m_) for (al_, dl_, m_) in _tsites]
+        cv_new = (commit_vid != val.NONE) & (pr.commit_vid == val.NONE)
+        took = cv_new & ~newly  # [P, I] commit-takeover adoptions
+        took_p = jnp.any(took, axis=1)  # [P]
+        new_tele = _rec.Telemetry(
+            offered=tele.offered + jnp.stack([c[0] for c in tc]),
+            dropped=tele.dropped + jnp.stack([c[1] for c in tc]),
+            duped=tele.duped + jnp.stack([c[2] for c in tc]),
+            delayed=tele.delayed + jnp.stack([c[3] for c in tc]),
+            learns=tele.learns + jnp.sum(
+                (learned != val.NONE) & (st.learned == val.NONE),
+                dtype=jnp.int32,
+            ),
+            commit_acks=tele.commit_acks + jnp.sum(crep, dtype=jnp.int32),
+            takeovers=tele.takeovers + jnp.sum(took, dtype=jnp.int32),
+            requeues=tele.requeues + jnp.sum(nreq, dtype=jnp.int32),
+            restarts=tele.restarts + jnp.sum(do_restart, dtype=jnp.int32),
+            admit_round=jnp.where(
+                (tele.admit_round == val.NONE) & _adm_any,
+                t, tele.admit_round,
+            ),
+            takeover_round=jnp.where(
+                (tele.takeover_round == val.NONE) & took_p,
+                t, tele.takeover_round,
+            ),
+            stall_max=jnp.maximum(tele.stall_max, jnp.max(stall)),
+        )
+        return new_st, new_tele
 
     return round_fn
 
@@ -1817,6 +1897,62 @@ def _run_loop_knobs(cfg: SimConfig, round_fn):
     return _go
 
 
+def _run_loop_telemetry(cfg: SimConfig, round_fn):
+    """Whole-run driver for a ``telemetry=True`` engine: the loop
+    carries ``(state, Telemetry)`` and the epilogue reduces the
+    recorder to its fixed-shape :class:`TelemetrySummary` INSIDE the
+    same jit — the per-instance admission ledger never crosses to
+    host (IR201 holds: no transfers in the loop body either).  This
+    is the surface the IR audit traces as
+    ``sim.run_rounds_telemetry``."""
+    from tpu_paxos.telemetry import recorder as telem
+
+    sched = cfg.faults.schedule
+    horizon = sched.horizon if sched is not None else 0
+
+    @jax.jit
+    def _go(root, state, tele):
+        def cond(c):
+            return (~c[0].done) & (c[0].t < cfg.round_budget)
+
+        def body(c):
+            return round_fn(root, c[0], tele=c[1])
+
+        final, tl = jax.lax.while_loop(cond, body, (state, tele))
+        return final, telem.summarize(tl, final, horizon)
+
+    return _go
+
+
+def run_with_telemetry(
+    cfg: SimConfig,
+    workload: list[np.ndarray] | None = None,
+    gates: list[np.ndarray] | None = None,
+):
+    """``run()`` with the flight recorder armed: returns ``(SimResult,
+    TelemetrySummary)`` (summary fields as host numpy).  Decision-log
+    identical to ``run()`` for the same (cfg, workload, gates) — the
+    recorder is read-only (parity pinned by tests/test_telemetry.py)."""
+    from tpu_paxos.telemetry import recorder as telem
+
+    if workload is None:
+        workload = default_workload(cfg)
+    pend, gate, tail, c = prepare_queues(cfg, workload, gates)
+    root = prng.root_key(cfg.seed)
+    state = init_state(cfg, pend, gate, tail, root)
+    expected = np.unique(
+        np.concatenate([np.asarray(w, np.int32).reshape(-1) for w in workload])
+    )
+    round_fn = build_engine(
+        cfg, c, vid_cap=gates_vid_cap(workload, gates), telemetry=True
+    )
+    _go = _run_loop_telemetry(cfg, round_fn)
+    tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+    with tracecount.engine_scope("sim"):
+        final, summ = _go(root, state, tele0)
+    return to_result(final, expected), jax.tree.map(np.asarray, summ)
+
+
 def to_result(final: SimState, expected_vids: np.ndarray) -> SimResult:
     """Marshal a final device state into the host-convention result
     (shared by run_state, the sharded runner, and the stress sweep)."""
@@ -1943,6 +2079,33 @@ def audit_entries():
         )
         return _run_loop_knobs(cfg, rf), (root, state, tab, knobs)
 
+    def build_telemetry():
+        # The flight-recorder surface: telemetry accumulators in the
+        # loop carry + the on-device summary reduction in the epilogue.
+        # Episode-schedule-bearing so every recorder family (fault-
+        # layer counters under cuts/bursts, pauses feeding the stall
+        # margin) is in the traced program the op budget pins; IR201
+        # must stay green — the ledger never leaves the device.
+        from tpu_paxos.telemetry import recorder as telem
+
+        sched = fltm.FaultSchedule((
+            fltm.partition(2, 10, (0,), (1, 2)),
+            fltm.pause(3, 8, 2),
+            fltm.burst(4, 9, 1500),
+        ))
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000,
+                               schedule=sched),
+        )
+        workload = default_workload(cfg)
+        pend, gate, tail, c = prepare_queues(cfg, workload, None)
+        root = prng.root_key(cfg.seed)
+        state = init_state(cfg, pend, gate, tail, root)
+        rf = build_engine(cfg, c, vid_cap=0, telemetry=True)
+        tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+        return _run_loop_telemetry(cfg, rf), (root, state, tele0)
+
     ir204_why = (
         "conflict-requeue compaction sorts on provably-unique keys "
         "(global instance ids / window offsets); instability cannot "
@@ -1962,6 +2125,11 @@ def audit_entries():
         AuditEntry(
             "sim.run_rounds_knobs", build_knobs,
             covers=("_run_loop_knobs",),
+            allow=("IR204",), why=ir204_why,
+        ),
+        AuditEntry(
+            "sim.run_rounds_telemetry", build_telemetry,
+            covers=("_run_loop_telemetry",),
             allow=("IR204",), why=ir204_why,
         ),
     ]

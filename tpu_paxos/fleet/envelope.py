@@ -72,9 +72,12 @@ def envelope_key(
     max_episodes: int,
     delay_bound: int,
     mesh,
+    telemetry: bool = False,
 ) -> tuple:
     """The hashable envelope of a (cfg, workload-template) pair —
-    exactly the static facts the compiled lane program depends on."""
+    exactly the static facts the compiled lane program depends on.
+    ``telemetry`` is part of the key: arming the flight recorder is a
+    different traced program (the recorder rides the loop carry)."""
     wl = [np.asarray(w, np.int32).reshape(-1) for w in workload]
     expected, owner = vdt.expected_owners(cfg, wl)
     gate_sig = (
@@ -82,6 +85,7 @@ def envelope_key(
         else tuple(len(np.asarray(g).reshape(-1)) for g in gates)
     )
     return (
+        bool(telemetry),
         cfg.n_nodes,
         cfg.proposers,
         cfg.n_instances,
@@ -107,8 +111,15 @@ def runner_for(
     max_episodes: int = frun.MAX_EPISODES,
     delay_bound: int | None = None,
     mesh=None,
+    telemetry: bool = False,
 ) -> frun.FleetRunner:
     """The shared compiled runner for ``cfg``'s envelope.
+
+    ``telemetry=True`` hands back the flight-recorder-armed twin of
+    the envelope (its own cache slot: the recorder changes the traced
+    program).  The stress sweep, the schedule search, and the shrink
+    evaluator all arm it, so the whole runtime triage stack still
+    shares ONE executable per geometry.
 
     ``cfg.faults`` is normalized away (the i.i.d. knobs and the
     schedule are runtime inputs of the returned runner — pass them to
@@ -124,14 +135,18 @@ def runner_for(
             f"cfg max_delay {cfg.faults.max_delay} exceeds the "
             f"requested envelope delay bound {delay_bound}"
         )
-    key = envelope_key(cfg, workload, gates, max_episodes, delay_bound, mesh)
+    key = envelope_key(
+        cfg, workload, gates, max_episodes, delay_bound, mesh,
+        telemetry=telemetry,
+    )
     runner = _CACHE.get(key)
     if runner is None:
         base = dataclasses.replace(
             cfg, seed=0, faults=FaultConfig(max_delay=delay_bound)
         )
         runner = frun.FleetRunner(
-            base, workload, gates, mesh=mesh, max_episodes=max_episodes
+            base, workload, gates, mesh=mesh, max_episodes=max_episodes,
+            telemetry=telemetry,
         )
         # the MUST above is enforced: run() rejects implicit
         # workloads/knobs on cache-shared runners

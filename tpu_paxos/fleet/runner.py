@@ -88,6 +88,11 @@ class FleetReport:
     final: simm.SimState  # device, lane-leading
     expected: np.ndarray  # the runner's template expected-vid set
     seconds: float
+    #: flight-recorder summaries, [lanes]-leading host numpy
+    #: (telemetry/recorder.TelemetrySummary) — None unless the runner
+    #: was built with ``telemetry=True``.  Reduced ON DEVICE inside
+    #: the lane jit; only these fixed small shapes ever transfer.
+    telemetry: object = None
     #: per-lane i.i.d. FaultConfig (schedule-free) — the knob mix each
     #: lane actually ran, whether passed explicitly or defaulted from
     #: the runner's base cfg; the source ``lane_cfg`` bakes back in.
@@ -110,6 +115,17 @@ class FleetReport:
         one = jax.tree.map(lambda x: x[i], self.final)
         exp = self.expected_lanes[i] if self.expected_lanes else self.expected
         return simm.to_result(one, exp)
+
+    def lane_telemetry(self, i: int):
+        """One lane's flight-recorder summary as a JSON-ready dict
+        (telemetry/recorder.summary_to_dict); None when the runner
+        ran recorder-free."""
+        if self.telemetry is None:
+            return None
+        from tpu_paxos.telemetry import recorder as telem
+
+        one = jax.tree.map(lambda x: x[i], self.telemetry)
+        return telem.summary_to_dict(one)
 
     def lane_cfg(self, i: int) -> SimConfig:
         """The single-run config this lane is decision-log-identical
@@ -143,6 +159,7 @@ class FleetRunner:
         gates: list[np.ndarray] | None = None,
         mesh=None,
         max_episodes: int = MAX_EPISODES,
+        telemetry: bool = False,
     ):
         if cfg.faults.schedule is not None:
             raise ValueError(
@@ -154,6 +171,7 @@ class FleetRunner:
         self.gates = gates
         self.mesh = mesh
         self.max_episodes = max_episodes
+        self.telemetry = telemetry
         self.delay_bound = cfg.faults.max_delay
         #: set by fleet/envelope.runner_for: a cache-shared runner's
         #: template queues and base knobs are whatever caller warmed
@@ -178,19 +196,45 @@ class FleetRunner:
             vid_cap=self._gate_vid_cap,
             runtime_schedule=True,
             runtime_knobs=True,
+            telemetry=telemetry,
         )
         vid_bound = self.vid_bound
 
-        def lane(root, st, tab, kn, exp, own):
-            def cond(s):
-                return (~s.done) & (s.t < cfg.max_rounds + tab.horizon)
+        if telemetry:
+            from tpu_paxos.telemetry import recorder as telem
 
-            final = jax.lax.while_loop(
-                cond, lambda s: round_fn(root, s, tab, kn), st
-            )
-            return final, vdt.lane_verdict(
-                cfg, final, exp, own, vid_cap=vid_bound
-            )
+            def lane(root, st, tab, kn, exp, own):
+                def cond(c):
+                    return (~c[0].done) & (
+                        c[0].t < cfg.max_rounds + tab.horizon
+                    )
+
+                # the zeroed accumulators are trace-time constants —
+                # no lane-axis plumbing needed
+                tele0 = telem.init_telemetry(
+                    cfg.n_instances, len(cfg.proposers)
+                )
+                final, tl = jax.lax.while_loop(
+                    cond,
+                    lambda c: round_fn(root, c[0], tab, kn, tele=c[1]),
+                    (st, tele0),
+                )
+                return (
+                    final,
+                    vdt.lane_verdict(cfg, final, exp, own, vid_cap=vid_bound),
+                    telem.summarize(tl, final, tab.horizon),
+                )
+        else:
+            def lane(root, st, tab, kn, exp, own):
+                def cond(s):
+                    return (~s.done) & (s.t < cfg.max_rounds + tab.horizon)
+
+                final = jax.lax.while_loop(
+                    cond, lambda s: round_fn(root, s, tab, kn), st
+                )
+                return final, vdt.lane_verdict(
+                    cfg, final, exp, own, vid_cap=vid_bound
+                )
 
         fl = jax.vmap(lane)
         if mesh is not None and mesh.size > 1:
@@ -202,7 +246,7 @@ class FleetRunner:
             fl = pmesh.shard_map(
                 fl, mesh,
                 in_specs=(spec,) * 6,
-                out_specs=(spec, spec),
+                out_specs=(spec,) * (3 if telemetry else 2),
             )
         self._fn = jax.jit(fl)
 
@@ -388,17 +432,24 @@ class FleetRunner:
             n_lanes, workloads
         )
         t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
+        tsum = None
         with tracecount.engine_scope("fleet"):
             states = self._init(
                 jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail),
                 roots,
             )
-            final, v = self._fn(
+            out = self._fn(
                 roots, states, tabs,
                 jax.tree.map(jnp.asarray, kn),
                 jnp.asarray(exp), jnp.asarray(own),
             )
+            if self.telemetry:
+                final, v, tsum = out
+            else:
+                final, v = out
         verdict = vdt.LaneVerdict(*(np.asarray(x) for x in v))
+        if tsum is not None:
+            tsum = jax.tree.map(np.asarray, tsum)
         seconds = time.perf_counter() - t0  # verdict transfer = the sync  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         return FleetReport(
             cfg=self.cfg,
@@ -409,6 +460,7 @@ class FleetRunner:
             final=final,
             expected=self.expected,
             seconds=seconds,
+            telemetry=tsum,
             fault_cfgs=fault_cfgs,
             expected_lanes=exp_list,
         )
@@ -428,7 +480,7 @@ def audit_entries():
     from tpu_paxos.core import faults as fltm
     from tpu_paxos.core.sim import audit_canonical_cfg
 
-    def build():
+    def _build(telemetry: bool):
         import dataclasses as dc
 
         cfg = dc.replace(
@@ -436,7 +488,9 @@ def audit_entries():
             faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
         )
         workload = simm.default_workload(cfg)
-        runner = FleetRunner(cfg, workload, max_episodes=2)
+        runner = FleetRunner(
+            cfg, workload, max_episodes=2, telemetry=telemetry
+        )
         scheds = [
             fltm.FaultSchedule((fltm.partition(2, 6, (0,), (1, 2)),)),
             fltm.FaultSchedule((
@@ -460,10 +514,22 @@ def audit_entries():
             jnp.asarray(exp), jnp.asarray(own),
         )
 
-    return [AuditEntry(
-        "fleet.run_lanes", build,
-        covers=("FleetRunner.__init__",),
-        allow=("IR204",),
-        why="the vmapped lane body IS core/sim's round_fn — same "
-            "unique-key compaction sorts as sim.run_rounds",
-    )]
+    ir204_why = (
+        "the vmapped lane body IS core/sim's round_fn — same "
+        "unique-key compaction sorts as sim.run_rounds"
+    )
+    return [
+        AuditEntry(
+            "fleet.run_lanes", lambda: _build(False),
+            covers=("FleetRunner.__init__",),
+            allow=("IR204",), why=ir204_why,
+        ),
+        AuditEntry(
+            # the telemetry-armed twin: recorder accumulators in the
+            # lane carry + the on-device summary reduction; IR201
+            # (no host transfers in the loop) is the load-bearing
+            # contract here — the ledger must never leave the device
+            "fleet.run_lanes_telemetry", lambda: _build(True),
+            allow=("IR204",), why=ir204_why,
+        ),
+    ]

@@ -92,6 +92,24 @@ def sample_schedule(
     ))
 
 
+def _generation_margins(rep) -> dict:
+    """Reduce one generation's [lanes] flight-recorder summaries to
+    the near-miss margin vector: the closest any lane came to a
+    liveness wedge (prep for ROADMAP item 2's fitness selection).
+    Margins shrink as lanes get closer to wedging — a fitness
+    function minimizes heal_gap and maximizes the depth fields."""
+    from tpu_paxos.telemetry import recorder as telem
+
+    ts = rep.telemetry
+    if ts is None:
+        return {}
+    agg = telem.reduce_lanes(ts)
+    return {k: agg[k] for k in (
+        "heal_gap_min", "stall_depth_max", "duel_depth_max",
+        "rounds_max", "takeovers", "latency_p99", "latency_max",
+    )}
+
+
 def search(
     n_lanes: int,
     generations: int,
@@ -141,6 +159,7 @@ def search(
     runner = env.runner_for(
         cfg, workload, gates, mesh=mesh,
         max_episodes=max(max_episodes, frun.MAX_EPISODES),
+        telemetry=True,
     )
     lane_workloads = [(workload, gates)] * n_lanes
     lane_knobs = [cfg.faults] * n_lanes
@@ -152,6 +171,7 @@ def search(
     lanes_total = 0
     wedges: list[dict] = []
     anomalies: list[dict] = []
+    gen_summaries: list[dict] = []
     for g in range(generations):
         sched_rng = np.random.default_rng((base_seed, g))
         schedules = [
@@ -176,6 +196,16 @@ def search(
             "generation %d: %d lanes, %d flagged (%.1f lanes/sec)",
             g, n_lanes, len(flagged), rep.lanes_per_sec,
         )
+        # Near-miss margin vector (telemetry/recorder.margins_vector):
+        # how close the generation's closest lane came to a liveness
+        # wedge — ROADMAP item 2's fitness signal, recorded per
+        # generation so mutate-and-select has a gradient to climb.
+        gen_summaries.append({
+            "generation": g,
+            "lanes": n_lanes,
+            "flagged": len(flagged),
+            "margins": _generation_margins(rep),
+        })
         for i in sorted(flagged):
             if len(wedges) >= max_wedges:
                 break
@@ -241,6 +271,7 @@ def search(
         "real_violations": len(real),
         "wedges": wedges,
         "anomalies": anomalies,
+        "generation_telemetry": gen_summaries,
         "ok": not real and not anomalies,
     }
 

@@ -189,8 +189,13 @@ def _runtime_candidate_eval(case: ReproCase):
     max_eps = max(
         frun.MAX_EPISODES, 0 if sched is None else len(sched.episodes)
     )
+    # telemetry=True: the stress sweep and the schedule search both
+    # arm the recorder, so the shrinker's candidates land on the SAME
+    # envelope key and reuse their compile (the recorder is
+    # decision-log-neutral, so the judge's verdicts are unchanged)
     runner = env.runner_for(
-        case.cfg, case.workload, case.gates, max_episodes=max_eps
+        case.cfg, case.workload, case.gates, max_episodes=max_eps,
+        telemetry=True,
     )
 
     def _eval(cand: ReproCase):

@@ -276,6 +276,38 @@ def sweep(
     }
 
 
+def _mix_telemetry(rep, cfg: SimConfig) -> dict:
+    """One mix's flight-recorder block: every value is a pure function
+    of (cfg, seeds) — no wall clock — so the block is golden-testable
+    (tests/test_telemetry.py pins it against
+    tests/data/stress_telemetry_golden.json).
+
+    ``drop_rate_observed`` is the built-in sanity column: i.i.d.-layer
+    drops over fault-layer offered edges, in the knob's per-1e4
+    units.  For burst-free mixes it should straddle the configured
+    ``drop_rate``; burst episodes push it above (their windows add to
+    the sampled rate)."""
+    from tpu_paxos.telemetry import recorder as telem
+
+    ts = rep.telemetry
+    if ts is None:
+        return {}
+    agg = telem.reduce_lanes(ts)
+    offered, dropped = agg["offered"], agg["dropped"]
+    return {
+        **{k: agg[k] for k in (
+            "offered", "dropped", "duped", "delayed",
+            "latency_p50", "latency_p99", "latency_max",
+            "decided", "takeovers", "requeues", "restarts",
+            "heal_gap_min", "stall_depth_max", "duel_depth_max",
+        )},
+        "drop_rate_configured": cfg.faults.drop_rate,
+        "drop_rate_observed": (
+            round(1e4 * dropped / offered, 1) if offered else 0.0
+        ),
+    }
+
+
 # jax.monitoring has no listener-removal API, so every CompileCensus
 # stays registered for the life of the process once started; reuse one
 # module-level census across sweep_fleet calls instead of leaking a
@@ -318,6 +350,7 @@ def sweep_fleet(
     runs, failures = 0, []
     lane_seconds, lanes_total = 0.0, 0
     compiles_per_mix: dict[str, int] = {}
+    telemetry_per_mix: dict[str, dict] = {}
     global _fleet_census
     if _fleet_census is None:
         _fleet_census = tracecount.CompileCensus()
@@ -343,7 +376,9 @@ def sweep_fleet(
                 max_rounds=20_000,
                 faults=FaultConfig(**base_kw),
             )
-            runner = env.runner_for(cfg, lanes[0][1], lanes[0][2])
+            runner = env.runner_for(
+                cfg, lanes[0][1], lanes[0][2], telemetry=True
+            )
             before = census.engine_counts.get("fleet", 0)
             rep = runner.run(
                 [ln[0] for ln in lanes],
@@ -354,6 +389,7 @@ def sweep_fleet(
             compiles_per_mix[label] = (
                 census.engine_counts.get("fleet", 0) - before
             )
+            telemetry_per_mix[label] = _mix_telemetry(rep, cfg)
             runs += n_seeds
             lanes_total += n_seeds
             lane_seconds += rep.seconds
@@ -412,6 +448,7 @@ def sweep_fleet(
         "lanes": lanes_total,
         "lanes_per_sec": round(lanes_total / max(lane_seconds, 1e-9), 2),
         "compiles_per_mix": compiles_per_mix,
+        "telemetry": telemetry_per_mix,
         "failures": failures,
         "ok": not failures,
         "seconds": round(time.perf_counter() - t0, 1),

@@ -1,0 +1,25 @@
+"""On-device telemetry plane: the flight recorder and its exporters.
+
+``recorder`` defines the device-side accumulators (per-round protocol
+counters, the per-instance latency ledger, near-miss margins) that the
+engines carry through their traced round loops when built with
+``telemetry=True``; ``export`` renders host-side summaries as
+Chrome-trace/Perfetto JSON timelines (``python -m tpu_paxos trace``).
+
+Submodules are lazily re-exported (PEP 562), mirroring ``core`` and
+``fleet``: ``recorder`` is imported by ``core.sim`` only when an
+engine is telemetry-armed, and importing the package must not eagerly
+drag in jax or the harness stack.
+"""
+
+_SUBMODULES = ("recorder", "export")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"tpu_paxos.telemetry.{name}")
+    raise AttributeError(
+        f"module 'tpu_paxos.telemetry' has no attribute {name!r}"
+    )

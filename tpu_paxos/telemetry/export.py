@@ -1,0 +1,297 @@
+"""Chrome-trace/Perfetto export: render a run as a browsable timeline.
+
+The recorder (telemetry/recorder.py) answers "how did this lane get
+here" in numbers; this module answers it visually — a Chrome-trace
+JSON (the ``chrome://tracing`` / https://ui.perfetto.dev format,
+``traceEvents`` array) with:
+
+- **fault episodes as duration events** on per-node tracks (a paused
+  node shows its pause window, a partitioned node its partition
+  window; burst-loss windows ride a synthetic "network" track);
+- **decisions and commit takeovers as instant events** (decisions on
+  a dedicated track with instance/vid/ballot args, takeovers on the
+  proposer node's track at the recorder's first-takeover round);
+- **counter tracks** (cumulative decided instances over rounds), plus
+  the full flight-recorder summary attached as the ``telemetry``
+  block of ``otherData``.
+
+One simulated round maps to one trace millisecond (``ROUND_US``).
+
+``python -m tpu_paxos trace <repro-artifact>`` renders any shrunk
+wedge artifact: the telemetry is RECOMPUTED at replay (the artifact
+schema is closed — no recorder fields are ever stored, pinned by
+tests/test_artifact_schema.py), riding the same determinism contract
+as ``repro``.  Sharded artifacts replay without the recorder (the
+sharded engine is recorder-free for now) — episodes and decisions
+still render; the summary block is absent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+# NOTE: no tpu_paxos.core / jax imports at module level — the CLI
+# selects its backend (and provisions a sharded artifact's virtual
+# mesh) AFTER import, and backend init is irreversible.
+
+#: Trace microseconds per simulated round (1 round = 1 ms: round
+#: numbers read directly off the Perfetto grid in milliseconds).
+ROUND_US = 1000
+
+#: Cap on per-instance decision instants (a million-instance run must
+#: not emit a million events; the counter track still shows the
+#: totals).  Dropped events are counted in otherData.
+MAX_DECISION_EVENTS = 1024
+
+_NET_TRACK = "network"
+_DECISION_TRACK = "decisions"
+
+
+def _ev(ph, name, pid, tid=0, ts=0, **kw):
+    e = {"ph": ph, "name": name, "pid": pid, "tid": tid, "ts": ts}
+    e.update(kw)
+    return e
+
+
+def _meta(events, pid, name):
+    events.append(
+        _ev("M", "process_name", pid, args={"name": name})
+    )
+
+
+def _episode_events(schedule, n_nodes: int, net_pid: int) -> list:
+    """Fault episodes as ``X`` (complete) duration events: one per
+    affected node per episode, plus burst windows on the network
+    track."""
+    events = []
+    if schedule is None:
+        return events
+    for e in schedule.episodes:
+        ts, dur = e.t0 * ROUND_US, (e.t1 - e.t0) * ROUND_US
+        if e.kind == "partition":
+            # unlisted nodes form one implicit extra group
+            # (core/faults.partition) — they are equally cut off and
+            # must show a bar, or the timeline reads as fault-free
+            # on exactly the nodes a wedge's quorum math hinges on
+            listed = {int(n) for g in e.groups for n in g}
+            implicit = tuple(sorted(set(range(n_nodes)) - listed))
+            groups = tuple(e.groups) + ((implicit,) if implicit else ())
+            for gi, group in enumerate(groups):
+                for node in group:
+                    events.append(_ev(
+                        "X", f"partition side {gi}", int(node), ts=ts,
+                        dur=dur, args={"t0": e.t0, "t1": e.t1},
+                    ))
+        elif e.kind == "one_way":
+            for node in e.src:
+                events.append(_ev(
+                    "X", f"one_way send-dark to {sorted(e.dst)}",
+                    int(node), ts=ts, dur=dur,
+                    args={"t0": e.t0, "t1": e.t1},
+                ))
+        elif e.kind == "pause":
+            for node in e.nodes:
+                events.append(_ev(
+                    "X", "pause", int(node), ts=ts, dur=dur,
+                    args={"t0": e.t0, "t1": e.t1},
+                ))
+        elif e.kind == "burst":
+            events.append(_ev(
+                "X", f"burst drop +{e.drop_rate}/1e4", net_pid,
+                ts=ts, dur=dur,
+                args={"t0": e.t0, "t1": e.t1, "drop_rate": e.drop_rate},
+            ))
+    return events
+
+
+def chrome_trace(cfg, result, summary_dict=None, label="tpu-paxos") -> dict:
+    """Build the Chrome-trace dict for one run.
+
+    ``result`` is a ``core/sim.SimResult``; ``summary_dict`` is the
+    flight recorder's ``summary_to_dict`` output (or None for
+    recorder-free replays, e.g. sharded artifacts)."""
+    from tpu_paxos.core import values as val
+
+    a = cfg.n_nodes
+    net_pid, dec_pid = a, a + 1
+    events = []
+    for node in range(a):
+        role = " (proposer)" if node in cfg.proposers else ""
+        _meta(events, node, f"node {node}{role}")
+    _meta(events, net_pid, _NET_TRACK)
+    _meta(events, dec_pid, _DECISION_TRACK)
+    events += _episode_events(cfg.faults.schedule, a, net_pid)
+
+    # decisions: instants on the decision track + a cumulative counter
+    chosen_vid = np.asarray(result.chosen_vid)
+    chosen_round = np.asarray(result.chosen_round)
+    chosen_ballot = np.asarray(result.chosen_ballot)
+    decided = np.flatnonzero(chosen_vid != int(val.NONE))
+    order = decided[np.argsort(chosen_round[decided], kind="stable")]
+    for k, i in enumerate(order[:MAX_DECISION_EVENTS]):
+        events.append(_ev(
+            "i", f"decide [{int(i)}]", dec_pid,
+            ts=int(chosen_round[i]) * ROUND_US, s="g",
+            args={
+                "instance": int(i),
+                "vid": int(chosen_vid[i]),
+                "ballot": int(chosen_ballot[i]),
+                "round": int(chosen_round[i]),
+            },
+        ))
+    rounds, counts = np.unique(chosen_round[decided], return_counts=True)
+    cum = 0
+    for r, n in zip(rounds.tolist(), counts.tolist()):
+        cum += n
+        events.append(_ev(
+            "C", "decided", dec_pid, ts=int(r) * ROUND_US,
+            args={"instances": cum},
+        ))
+
+    # commit takeovers: instants on the adopting proposer's node track
+    if summary_dict is not None:
+        for pi, tr in enumerate(summary_dict.get("takeover_round", [])):
+            if tr is not None and int(tr) >= 0:
+                events.append(_ev(
+                    "i", "commit takeover", int(cfg.proposers[pi]),
+                    ts=int(tr) * ROUND_US, s="p",
+                    args={"proposer": pi, "round": int(tr)},
+                ))
+
+    other = {
+        "label": label,
+        "rounds": int(result.rounds),
+        "done": bool(result.done),
+        "n_nodes": a,
+        "decided": int(len(decided)),
+        "decision_events_dropped": max(
+            0, int(len(decided)) - MAX_DECISION_EVENTS
+        ),
+        "round_us": ROUND_US,
+    }
+    if summary_dict is not None:
+        other["telemetry"] = summary_dict
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": other,
+    }
+
+
+def trace_artifact(path: str) -> dict:
+    """Re-execute a repro artifact with the flight recorder armed and
+    render the Chrome trace.  Telemetry is recomputed at replay —
+    never read from (or written to) the artifact, whose schema stays
+    closed."""
+    from tpu_paxos.core import sim as simm
+    from tpu_paxos.harness import shrink as shr
+    from tpu_paxos.telemetry import recorder as telem
+
+    case, art = shr.load_artifact(path)
+    if case.engine == "sim":
+        result, summ = simm.run_with_telemetry(
+            case.cfg, case.workload, case.gates
+        )
+        summary_dict = telem.summary_to_dict(summ)
+    else:
+        # sharded replays are recorder-free (build_engine rejects
+        # telemetry with axis_name); episodes + decisions still render
+        result, _ = shr.run_case(case)
+        summary_dict = None
+    trace = chrome_trace(case.cfg, result, summary_dict, label=path)
+    trace["otherData"]["artifact"] = path
+    trace["otherData"]["recorded_violation"] = art["violation"]
+    trace["otherData"]["engine"] = case.engine
+    return trace
+
+
+def main(argv=None) -> int:
+    """``python -m tpu_paxos trace <artifact>`` — render a repro
+    artifact as a Chrome-trace JSON timeline (open in
+    https://ui.perfetto.dev or chrome://tracing)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_paxos trace",
+        description="render a stress-triage repro artifact as a "
+        "Chrome-trace/Perfetto timeline (telemetry recomputed at "
+        "replay; the artifact itself is never modified)",
+    )
+    ap.add_argument("artifact", help="path to a repro .json (written "
+                    "by the stress sweep's --triage-dir)")
+    ap.add_argument("--out", type=str, default="",
+                    help="write the trace JSON here (default: "
+                    "<artifact>.trace.json)")
+    ap.add_argument("--stdout", action="store_true",
+                    help="print the trace JSON to stdout instead of "
+                    "writing a file")
+    ap.add_argument("--backend", choices=("tpu", "cpu", "auto"),
+                    default="auto")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON status line instead of the "
+                    "verdict line")
+    ap.add_argument("--log-level", type=str, default="INFO")
+    args = ap.parse_args(argv)
+    import os
+
+    # same determinism surface as `repro`: replay output must not
+    # capture wall clock
+    os.environ.setdefault("TPU_PAXOS_DETERMINISTIC", "1")
+    from tpu_paxos.__main__ import _emit, _level, _select_backend
+
+    # Peek the artifact header BEFORE backend init (same dance as
+    # run_repro): a sharded artifact records the device count its
+    # decision log was produced at, and virtual CPU devices cannot be
+    # added after the backend initializes.  Malformed artifacts fall
+    # through to load_artifact's clean exit-2 schema error.
+    devices = 1
+    try:
+        with open(args.artifact) as f:
+            hdr = json.load(f)
+        if isinstance(hdr, dict) and hdr.get("engine") == "sharded":
+            devices = int(hdr.get("devices", 1))
+    except (OSError, ValueError, TypeError):
+        devices = 1
+    if devices > 1:
+        backend = "cpu" if args.backend == "auto" else args.backend
+        _select_backend(backend, mesh=devices)
+    else:
+        _select_backend(args.backend)
+    from tpu_paxos.analysis.artifact_schema import ArtifactSchemaError
+    from tpu_paxos.utils import log as logm
+
+    logger = logm.get_logger("trace", _level(args))
+    try:
+        trace = trace_artifact(args.artifact)
+    except ArtifactSchemaError as e:
+        logger.error("%s", e)
+        _emit(args, {
+            "engine": "trace", "ok": False,
+            "schema_error": {"field": e.field, "problem": e.problem},
+        })
+        return 2
+    text = json.dumps(trace, indent=1, sort_keys=True)
+    if args.stdout:
+        sys.stdout.write(text + "\n")
+        return 0
+    out = args.out or (args.artifact + ".trace.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text + "\n")
+    os.replace(tmp, out)
+    logger.info("trace written to %s", out)
+    _emit(args, {
+        "engine": "trace",
+        "ok": True,
+        "out": out,
+        "events": len(trace["traceEvents"]),
+        "rounds": trace["otherData"]["rounds"],
+        "decided": trace["otherData"]["decided"],
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

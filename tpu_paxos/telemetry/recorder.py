@@ -1,0 +1,301 @@
+"""The flight recorder: on-device accumulators for the traced round
+loops of the general engine and the fleet.
+
+The only windows into a run used to be the terminal verdict, the
+decision-log sha256, and a post-hoc shrink — *that* a lane failed,
+never *how it got there*.  The recorder answers "how" without leaving
+the device: a small :class:`Telemetry` NamedTuple rides the loop carry
+next to ``SimState`` (``core/sim.build_engine(..., telemetry=True)``),
+every field updated from values the round function already computes,
+and a :class:`TelemetrySummary` of fixed small shapes is reduced on
+device at the end of the run — under the fleet vmap that means
+``[lanes, ...]`` summaries and nothing per-instance ever crosses to
+host.
+
+Three field families:
+
+- **protocol counters** — per message type (``MSG_NAMES`` order, the
+  ``Metrics.msgs`` convention): copies dropped / duplicated / delayed
+  by the fault layer on offered edges, plus event counts (newly
+  learned cells, commit-ack replies delivered, commit takeovers,
+  conflict requeues, ballot restarts);
+- **latency ledger** — round-of-admission per instance (the first
+  round the instance had a value in an accept batch), reduced against
+  ``chosen_round`` into a fixed-bucket commit-latency histogram
+  (``LAT_EDGES``);
+- **near-miss margins** — the fitness vector guided adversarial
+  search wants (ROADMAP item 2): heal-to-quiesce gap, max
+  commit-ladder stall depth, max duel depth (ballot count), first
+  takeover round per proposer.
+
+Neutrality contract: the recorder is READ-ONLY — it consumes no PRNG
+streams and never feeds back into ``SimState``, so a telemetry-armed
+engine is decision-log-identical to the plain one (sha256 parity
+pinned by tests/test_telemetry.py for the general engine, fleet
+lanes, and the runtime-knob path), and a ``telemetry=False`` build
+traces the exact program it traced before (compile-census zero-delta
+on warmed envelopes).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from tpu_paxos.core import values as val
+
+#: Message-type order of every [7] counter (the ``Metrics.msgs``
+#: convention in core/sim.py's message-counter block).
+MSG_NAMES = (
+    "prepare",
+    "prepare_reply",
+    "reject",
+    "accept",
+    "accept_reply",
+    "commit",
+    "commit_reply",
+)
+
+#: Commit-latency histogram bucket upper edges, in rounds; the last
+#: bucket is the overflow (> LAT_EDGES[-1]).  Fixed at trace time so
+#: the summary shape never depends on the run.
+LAT_EDGES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+NUM_LAT_BUCKETS = len(LAT_EDGES) + 1
+
+
+class Telemetry(NamedTuple):
+    """Per-round accumulators carried through the traced loop (one
+    lane; ``[lanes, ...]`` under the fleet vmap).  ``admit_round`` is
+    the only per-instance field and never leaves the device — it is
+    reduced into the latency histogram by :func:`summarize`."""
+
+    offered: np.ndarray  # [7] int32 edges offered to the fault layer
+    #     (post-cut: a message lost at a severed edge's NIC never
+    #     reaches the drop sampler, so observed-vs-configured rate
+    #     comparisons stay exact under schedule cuts)
+    dropped: np.ndarray  # [7] int32 copies dropped on offered edges
+    duped: np.ndarray  # [7] int32 duplicate copies spawned
+    delayed: np.ndarray  # [7] int32 surviving copies with delay > 0
+    learns: np.ndarray  # int32 newly learned (node, instance) cells
+    commit_acks: np.ndarray  # int32 commit-ack replies delivered
+    takeovers: np.ndarray  # int32 instances adopted by commit takeover
+    requeues: np.ndarray  # int32 conflict requeues appended
+    restarts: np.ndarray  # int32 proposer ballot restarts
+    admit_round: np.ndarray  # [I] int32 first round in an accept batch
+    takeover_round: np.ndarray  # [P] int32 first takeover round (NONE)
+    stall_max: np.ndarray  # int32 max stall counter ever observed
+
+
+class TelemetrySummary(NamedTuple):
+    """The reduced, fixed-shape summary that crosses to host (scalar
+    fields per lane; ``[lanes, ...]`` under the fleet vmap)."""
+
+    msgs: np.ndarray  # [7] int32 logical sends (pre-fault, = met.msgs)
+    offered: np.ndarray  # [7] int32 edges offered to the fault layer
+    dropped: np.ndarray  # [7] int32
+    duped: np.ndarray  # [7] int32
+    delayed: np.ndarray  # [7] int32
+    learns: np.ndarray  # int32
+    commit_acks: np.ndarray  # int32
+    takeovers: np.ndarray  # int32
+    requeues: np.ndarray  # int32
+    restarts: np.ndarray  # int32
+    decided: np.ndarray  # int32 instances decided
+    lat_hist: np.ndarray  # [NUM_LAT_BUCKETS] int32 commit-latency
+    lat_max: np.ndarray  # int32 max commit latency (-1: none decided)
+    heal_gap: np.ndarray  # int32 quiesce round - last heal (-1: never)
+    stall_max: np.ndarray  # int32 max commit-ladder stall depth
+    duel_max: np.ndarray  # int32 max ballot count (duel depth)
+    takeover_round: np.ndarray  # [P] int32 first takeover round (NONE)
+    rounds: np.ndarray  # int32 rounds simulated
+    quiescent: np.ndarray  # bool the engine's done predicate held
+
+
+def init_telemetry(n_instances: int, n_proposers: int) -> Telemetry:
+    """Zeroed accumulators for one lane (host numpy: the fleet runner
+    feeds these through ``jnp.asarray`` like every other lane input)."""
+    import jax.numpy as jnp
+
+    return Telemetry(
+        offered=jnp.zeros((7,), jnp.int32),
+        dropped=jnp.zeros((7,), jnp.int32),
+        duped=jnp.zeros((7,), jnp.int32),
+        delayed=jnp.zeros((7,), jnp.int32),
+        learns=jnp.int32(0),
+        commit_acks=jnp.int32(0),
+        takeovers=jnp.int32(0),
+        requeues=jnp.int32(0),
+        restarts=jnp.int32(0),
+        admit_round=jnp.full((n_instances,), val.NONE, jnp.int32),
+        takeover_round=jnp.full((n_proposers,), val.NONE, jnp.int32),
+        stall_max=jnp.int32(0),
+    )
+
+
+def count_copies(al, dl, mask):
+    """One message type's fault-layer counters from the already-sampled
+    copy plan (``net.copy_plan`` output) and the (post-cut) send mask:
+    (offered, dropped, duped, delayed) int32 scalars.  Copy 0 is the
+    original; copies 1..3 are the duplicate chain (never dropped)."""
+    import jax.numpy as jnp
+
+    offered = jnp.sum(mask, dtype=jnp.int32)
+    dropped = jnp.sum(mask & ~al[0], dtype=jnp.int32)
+    duped = jnp.sum(mask[None] & al[1:], dtype=jnp.int32)
+    delayed = jnp.sum(mask[None] & al & (dl > 0), dtype=jnp.int32)
+    return offered, dropped, duped, delayed
+
+
+def summarize(tele: Telemetry, final, horizon) -> TelemetrySummary:
+    """Reduce one lane's accumulators + final state to the fixed-shape
+    summary, on device.  ``final`` is the engine's final ``SimState``;
+    ``horizon`` is the schedule's last-heal round (int, or a traced
+    scalar from a runtime ``ScheduleTable``)."""
+    import jax.numpy as jnp
+
+    met = final.met
+    decided_mask = met.chosen_vid != val.NONE  # [I]
+    decided = jnp.sum(decided_mask, dtype=jnp.int32)
+    # Commit latency per decided instance: round-of-chosen minus
+    # round-of-admission (admission always precedes the decision — a
+    # decision requires acks on a batch the admission pass observed).
+    lat = met.chosen_round - tele.admit_round  # [I]
+    lat_ok = decided_mask & (tele.admit_round != val.NONE)
+    lat = jnp.where(lat_ok, jnp.maximum(lat, 0), 0)
+    edges = jnp.asarray(LAT_EDGES, jnp.int32)
+    bucket = jnp.sum(lat[:, None] > edges[None, :], axis=1)  # [I] in 0..B-1
+    lat_hist = jnp.zeros((NUM_LAT_BUCKETS,), jnp.int32).at[bucket].add(
+        lat_ok.astype(jnp.int32)
+    )
+    lat_max = jnp.max(jnp.where(lat_ok, lat, -1))
+    heal_gap = jnp.where(
+        final.done, final.t - jnp.asarray(horizon, jnp.int32), jnp.int32(-1)
+    )
+    return TelemetrySummary(
+        msgs=met.msgs,
+        offered=tele.offered,
+        dropped=tele.dropped,
+        duped=tele.duped,
+        delayed=tele.delayed,
+        learns=tele.learns,
+        commit_acks=tele.commit_acks,
+        takeovers=tele.takeovers,
+        requeues=tele.requeues,
+        restarts=tele.restarts,
+        decided=decided,
+        lat_hist=lat_hist,
+        lat_max=lat_max,
+        heal_gap=heal_gap,
+        stall_max=tele.stall_max,
+        duel_max=jnp.max(final.prop.count),
+        takeover_round=tele.takeover_round,
+        rounds=final.t,
+        quiescent=final.done,
+    )
+
+
+# ---------------- host-side rendering ----------------
+
+
+def latency_quantile(hist: np.ndarray, q: float, lat_max: int) -> int:
+    """Bucket-resolution quantile estimate: upper edge of the bucket
+    the q-th decided instance falls in, clamped to the observed max
+    (so p50 <= p99 <= latency_max always holds; the overflow bucket
+    reports the exact observed max).  -1 when nothing was decided."""
+    hist = np.asarray(hist)
+    total = int(hist.sum())
+    if total == 0:
+        return -1
+    target = q * total
+    cum = 0
+    for b, n in enumerate(hist.tolist()):
+        cum += n
+        if cum >= target and n:
+            if b < len(LAT_EDGES):
+                return min(int(LAT_EDGES[b]), int(lat_max))
+            return int(lat_max)
+    return int(lat_max)
+
+
+def summary_to_dict(s: TelemetrySummary) -> dict:
+    """One lane's summary as a JSON-ready dict (plain ints/lists),
+    with derived p50/p99 latency estimates.  Under the fleet vmap
+    index the summary first (``jax.tree.map(lambda x: x[i], s)``)."""
+    hist = np.asarray(s.lat_hist)
+    lat_max = int(s.lat_max)
+    offered = np.asarray(s.offered)
+    dropped = np.asarray(s.dropped)
+    return {
+        "msgs": {n: int(v) for n, v in zip(MSG_NAMES, np.asarray(s.msgs))},
+        "offered": {n: int(v) for n, v in zip(MSG_NAMES, offered)},
+        "dropped": {n: int(v) for n, v in zip(MSG_NAMES, dropped)},
+        "duped": {n: int(v) for n, v in zip(MSG_NAMES, np.asarray(s.duped))},
+        "delayed": {
+            n: int(v) for n, v in zip(MSG_NAMES, np.asarray(s.delayed))
+        },
+        "offered_total": int(offered.sum()),
+        "dropped_total": int(dropped.sum()),
+        "drop_rate_observed": (
+            round(1e4 * float(dropped.sum()) / float(offered.sum()), 1)
+            if int(offered.sum()) else 0.0
+        ),
+        "learns": int(s.learns),
+        "commit_acks": int(s.commit_acks),
+        "takeovers": int(s.takeovers),
+        "requeues": int(s.requeues),
+        "restarts": int(s.restarts),
+        "decided": int(s.decided),
+        "latency_hist": hist.tolist(),
+        "latency_edges": list(LAT_EDGES),
+        "latency_p50": latency_quantile(hist, 0.50, lat_max),
+        "latency_p99": latency_quantile(hist, 0.99, lat_max),
+        "latency_max": lat_max,
+        "heal_gap": int(s.heal_gap),
+        "stall_max": int(s.stall_max),
+        "duel_max": int(s.duel_max),
+        "takeover_round": np.asarray(s.takeover_round).tolist(),
+        "rounds": int(s.rounds),
+        "quiescent": bool(s.quiescent),
+    }
+
+
+def margins_vector(s: TelemetrySummary) -> dict:
+    """The near-miss margin subset (the search's fitness vector,
+    ROADMAP item 2): how close the lane came to a liveness wedge."""
+    return {
+        "heal_gap": int(s.heal_gap),
+        "stall_max": int(s.stall_max),
+        "duel_max": int(s.duel_max),
+        "rounds": int(s.rounds),
+        "latency_max": int(s.lat_max),
+    }
+
+
+def reduce_lanes(s: TelemetrySummary) -> dict:
+    """Across-lane aggregate of a ``[lanes]``-leading summary stack —
+    the ONE owner of the stack-reduction semantics (never-quiesced
+    ``-1`` heal gaps excluded from the min; latency quantiles over
+    the summed histogram).  The stress sweep's per-mix block and the
+    search's per-generation margins both derive from this dict."""
+    gaps = np.asarray(s.heal_gap)
+    quiesced = gaps[gaps >= 0]
+    hist = np.asarray(s.lat_hist).sum(axis=0)
+    lat_max = int(np.asarray(s.lat_max).max())
+    return {
+        "offered": int(np.asarray(s.offered).sum()),
+        "dropped": int(np.asarray(s.dropped).sum()),
+        "duped": int(np.asarray(s.duped).sum()),
+        "delayed": int(np.asarray(s.delayed).sum()),
+        "decided": int(np.asarray(s.decided).sum()),
+        "takeovers": int(np.asarray(s.takeovers).sum()),
+        "requeues": int(np.asarray(s.requeues).sum()),
+        "restarts": int(np.asarray(s.restarts).sum()),
+        "heal_gap_min": int(quiesced.min()) if quiesced.size else -1,
+        "stall_depth_max": int(np.asarray(s.stall_max).max()),
+        "duel_depth_max": int(np.asarray(s.duel_max).max()),
+        "rounds_max": int(np.asarray(s.rounds).max()),
+        "latency_p50": latency_quantile(hist, 0.50, lat_max),
+        "latency_p99": latency_quantile(hist, 0.99, lat_max),
+        "latency_max": lat_max,
+    }
