@@ -27,14 +27,19 @@ lint-json:
 
 # jaxpr-audit: trace-time IR contracts (IR201-IR205) + pinned op/cost
 # budget over the registered entry points of both engines and the
-# sharded path (tpu_paxos/analysis/jaxpr_audit.py).  Traces on CPU —
-# ops counts are backend-independent.  Re-pin after intentional
-# program growth: TPU_PAXOS_OP_BUDGET_PIN=1 make audit.
+# sharded path (tpu_paxos/analysis/jaxpr_audit.py), PLUS the
+# compiled-artifact tier (--hlo, tpu_paxos/analysis/hlo_audit.py):
+# normalized-HLO goldens for the hot kernels, per-primitive
+# instruction budgets + memory ceilings, and the donation/aliasing
+# checker.  Traces on CPU — ops counts are backend-independent; the
+# HLO pins are backend-gated.  Re-pin after intentional program
+# growth: TPU_PAXOS_OP_BUDGET_PIN=1 make audit (jaxpr tier) /
+# TPU_PAXOS_HLO_PIN=1 make audit (HLO goldens + budget).
 audit:
-	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit
+	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --hlo
 
 audit-json:
-	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --json
+	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --hlo --json
 
 # Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
 # static analyzers first (cheapest signal), then the fast tier with

@@ -1,0 +1,98 @@
+"""Seeded compiled-artifact regressions for the hlo audit.
+
+Loaded as an audit provider via ``--providers tests/data/hlo_fixture.py``
+(tests/test_hlo_audit.py and its CLI e2e layer).  Two entries, each
+hiding its regression behind an env flag the way a real one would ship
+— behind a config flag nobody flips in review:
+
+- ``hlofix.donated`` — a jitted state-recycling step that donates its
+  state arg.  ``TPU_PAXOS_HLO_FIXTURE_DROP_DONATION=1`` silently drops
+  ``donate_argnums`` (the wrapper-re-jit / flag regression); the
+  donation checker must fail naming the entry and the parameter.
+- ``hlofix.widen`` — a small golden-pinned kernel.
+  ``TPU_PAXOS_HLO_FIXTURE_WIDEN=1`` routes it through a float detour
+  (dtype widening -> extra ``convert`` instructions in the compiled
+  module); the per-primitive budget and/or the golden diff must fail
+  naming the entry, with the diff dumped to the triage dir.
+
+The flags are read at module-exec time: ``jaxpr_audit._load_provider_arg``
+re-executes the file on every load, so a test flips the env var and
+reloads to arm a regression.
+"""
+
+import os
+
+from tpu_paxos.analysis.registry import AuditEntry
+
+_DROP_DONATION = os.environ.get(
+    "TPU_PAXOS_HLO_FIXTURE_DROP_DONATION", "") not in ("", "0")
+_WIDEN = os.environ.get(
+    "TPU_PAXOS_HLO_FIXTURE_WIDEN", "") not in ("", "0")
+
+_N = 64
+
+
+def _make_recycle():
+    """The product-style jit under donation test: state in, state out,
+    same shapes/dtypes — the compiler CAN alias every leaf, so a
+    missing alias means the donation was dropped, not unusable."""
+    import jax
+
+    def recycle(state, delta):
+        return {
+            "acc": state["acc"] + delta,
+            "seen": state["seen"] | (delta > 0),
+        }
+
+    donate = () if _DROP_DONATION else (0,)
+    return jax.jit(recycle, donate_argnums=donate)
+
+
+def _widen_detour(y):
+    """The seeded widening, hidden behind a helper like IR202's: four
+    converts (i32->f32->i32 twice) — enough to breach a clean-pinned
+    convert cap, and a guaranteed golden diff."""
+    import jax.numpy as jnp
+
+    y = y.astype(jnp.float32) * 1.5
+    y = y.astype(jnp.int32)
+    z = (y + 1).astype(jnp.float32)
+    return (z * 2.0).astype(jnp.int32)
+
+
+def audit_entries():
+    import jax.numpy as jnp
+
+    def build_donated():
+        state = {
+            "acc": jnp.arange(_N, dtype=jnp.int32),
+            "seen": jnp.zeros((_N,), jnp.bool_),
+        }
+        delta = jnp.ones((_N,), jnp.int32)
+        fn = _make_recycle()
+        return fn, (state, delta)
+
+    def build_widen():
+        x = jnp.arange(_N, dtype=jnp.int32)
+
+        def fn(x):
+            y = x * 3 + 7
+            if _WIDEN:
+                y = _widen_detour(y)
+            return y - x
+
+        return fn, (x,)
+
+    return [
+        AuditEntry(
+            "hlofix.donated", build_donated,
+            covers=("_make_recycle",),
+            donate_argnums=(0,),
+            cost=False,
+        ),
+        AuditEntry(
+            "hlofix.widen", build_widen,
+            cost=False,
+            hlo_golden=True,
+        ),
+    ]

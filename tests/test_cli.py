@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -36,6 +38,11 @@ def _run(*args: str, timeout: int = 420):
     )
 
 
+# Slow tier (time budget, ~23s cold subprocess): the debug.conf fault
+# rates run fast-tier in test_sim.test_reference_fault_rates[0] and
+# the knobs debug.conf parity cell; the CLI surface itself is covered
+# fast-tier by the fast/member/sharded/json CLI tests below.
+@pytest.mark.slow
 def test_cli_sim_debug_conf_analog():
     # the transliterated multi/debug.conf.sample line
     p = _run(

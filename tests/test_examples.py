@@ -11,11 +11,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "0*.py")))
 
 
-# 04_sharded_and_checkpoint is the heaviest example (~60-85s: sharded
-# engine + checkpoint round-trip in a cold subprocess) and its coverage
-# is carried fast-tier by test_sharded / test_checkpoint /
-# test_sharded_repro, so it runs slow-tier to hold the tier-1 time
-# budget.
+# The heavy examples ride the slow tier to hold the tier-1 time
+# budget; each one's coverage is carried fast-tier elsewhere:
+# 02_faulty_run (~19s) by test_faults / test_replay,
+# 04_sharded_and_checkpoint (~60-85s: sharded engine + checkpoint
+# round-trip in a cold subprocess) by test_sharded / test_checkpoint /
+# test_sharded_repro, and 05_crash_rejoin_replay (~9s) by
+# test_crash_rejoin / test_replay.  01 and 03 keep the
+# examples-run-green contract fast-tier.
+_SLOW_EXAMPLES = ("02_", "04_", "05_")
+
+
 @pytest.mark.parametrize(
     "path",
     [
@@ -23,7 +29,7 @@ EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "0*.py")))
             p,
             id=os.path.basename(p),
             marks=[pytest.mark.slow]
-            if os.path.basename(p).startswith("04_")
+            if os.path.basename(p).startswith(_SLOW_EXAMPLES)
             else [],
         )
         for p in EXAMPLES

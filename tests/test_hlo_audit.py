@@ -1,0 +1,434 @@
+"""hlo-audit: compiled-artifact contracts — normalizer, donation
+checker, per-primitive budgets + memory ceilings, goldens, bounded
+triage dumps, CLI.
+
+Three layers, mirroring tests/test_jaxpr_audit.py:
+
+- **Normalizer contract** (pure text + one cheap real entry): the
+  same entry lowered twice normalizes byte-identically; a metadata /
+  value-numbering perturbation normalizes away; a structural change
+  does not.
+- **Fixture layer** (tests/data/hlo_fixture.py): the two seeded
+  regressions — a dropped ``donate_argnums`` behind a flag (the
+  aliasing checker must fail naming entry + parameter) and an
+  injected dtype widening (per-primitive budget AND golden diff must
+  fail, diff dumped to the triage dir).
+- **Repo + CLI layer**: the cheap registered entries fast-tier
+  against the committed pins; the full golden sweep (every entry
+  compiled, ~2 min) slow-tier; CLI e2e asserting exit codes and
+  named culprits.
+"""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_paxos.analysis import hlo_audit, hlo_norm, jaxpr_audit, triage
+from tpu_paxos.analysis import registry as regm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "data", "hlo_fixture.py")
+
+#: Cheap registered providers (sub-second compiles) — the fast-tier
+#: slice of the repo audit; the full registry runs slow-tier.
+CHEAP_PROVIDERS = (
+    "tpu_paxos.core.fast",
+    "tpu_paxos.core.simkern",
+    "tpu_paxos.core.fastwin",
+)
+
+RAW = """\
+HloModule jit_f, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, may-alias) }, entry_computation_layout={(s32[8]{0})->s32[8]{0}}
+
+%fused_computation.123 (param_0.7: s32[8], param_1.9: s32[8]) -> s32[8] {
+  %param_0.7 = s32[8]{0} parameter(0)
+  %param_1.9 = s32[8]{0} parameter(1)
+  ROOT %add.991 = s32[8]{0} add(s32[8]{0} %param_0.7, s32[8]{0} %param_1.9), metadata={op_name="jit(f)/add" source_file="/x/y.py" source_line=7}
+}
+
+ENTRY %main.42 (p0.1: s32[8], p1.2: s32[8]) -> s32[8] {
+  %p0.1 = s32[8]{0} parameter(0)
+  %p1.2 = s32[8]{0} parameter(1)
+  %copy.17 = s32[8]{0} copy(s32[8]{0} %p1.2), metadata={op_name="x{y}" source_file="/x/y.py" source_line=9}
+  ROOT %fusion.5 = s32[8]{0} fusion(s32[8]{0} %p0.1, /*index=1*/s32[8]{0} %copy.17), kind=kLoop, calls=%fused_computation.123
+}
+"""
+
+
+# ---------------- normalizer (pure text) ----------------
+
+def test_normalize_strips_noise_and_renumbers():
+    norm = hlo_norm.normalize(RAW)
+    # header: only the module name + alias table survive
+    assert norm.splitlines()[0] == (
+        "HloModule jit_f, input_output_alias="
+        "{{0}: (0, {}, may-alias), {1}: (2, {}, may-alias)}"
+    )
+    assert "metadata=" not in norm
+    assert "source_line" not in norm
+    assert "is_scheduled" not in norm
+    assert "entry_computation_layout" not in norm
+    assert "/*index=" not in norm
+    assert "{0}" in norm.splitlines()[0]  # alias tuple kept
+    assert "s32[8]{0}" not in norm  # layouts stripped
+    # ids renumbered from 0 in first-appearance order
+    assert "%fused_computation.0" in norm
+    assert "%add.0" in norm and "%add.991" not in norm
+    # the signature's bare (un-sigiled) param ids renumber too
+    assert "param_0.7" not in norm
+
+
+def test_normalize_value_numbering_is_first_appearance_stable():
+    import re
+
+    bumped = re.sub(
+        r"(%?[A-Za-z_][\w-]*)\.(\d+)",
+        lambda m: f"{m.group(1)}.{int(m.group(2)) + 1000}", RAW,
+    )
+    assert hlo_norm.normalize(bumped) == hlo_norm.normalize(RAW)
+
+
+def test_normalize_metadata_perturbation_normalizes_away():
+    pert = RAW.replace("source_line=7", "source_line=12345")
+    assert hlo_norm.normalize(pert) == hlo_norm.normalize(RAW)
+
+
+def test_normalize_structural_change_survives():
+    # an extra convert is a real program change, not noise
+    lines = RAW.splitlines()
+    idx = next(i for i, l in enumerate(lines) if "%copy.17" in l)
+    lines.insert(
+        idx, "  %convert.3 = f32[8]{0} convert(s32[8]{0} %p1.2)"
+    )
+    assert hlo_norm.normalize("\n".join(lines)) != hlo_norm.normalize(RAW)
+
+
+def test_strip_attr_is_quote_and_brace_aware():
+    # op_name carries braces inside the quoted string (jaxpr params
+    # leak into provenance) — the stripper must not stop early
+    line = (
+        '  %a.1 = s32[] add(%b.2, %c.3), '
+        'metadata={op_name="while[body={x}]" source_file="f.py"}, '
+        'backend_config="cfg"'
+    )
+    out = hlo_norm._strip_attr(line, "metadata")
+    assert "metadata" not in out
+    assert 'backend_config="cfg"' in out
+
+
+def test_opcode_histogram_and_summary():
+    hist = hlo_norm.opcode_histogram(hlo_norm.normalize(RAW))
+    assert hist["add"] == 1
+    assert hist["copy"] == 1
+    assert hist["fusion"] == 1
+    assert hist["parameter"] == 4
+    summary = hlo_norm.histogram_summary(
+        {"fusion": 2, "copy": 1, "copy-start": 3, "copy-done": 3,
+         "while": 1, "add": 5}
+    )
+    assert summary == {
+        "hlo_ops": 15, "fusion": 2, "copy": 7, "convert": 0,
+        "transpose": 0, "while": 1,
+    }
+
+
+def test_alias_table_parses_nested_braces():
+    assert hlo_norm.alias_table(RAW) == [
+        {"output": (0,), "param": 0, "kind": "may-alias"},
+        {"output": (1,), "param": 2, "kind": "may-alias"},
+    ]
+    assert hlo_norm.aliased_params(RAW) == {0, 2}
+    assert hlo_norm.alias_table("HloModule jit_g\n") == []
+
+
+# ---------------- normalizer (real lowering) ----------------
+
+def _lower_text(entry) -> str:
+    lowered, _args = hlo_audit.lower_entry(entry)
+    return lowered.compile().as_text() or ""
+
+
+@pytest.fixture(scope="module")
+def fast_entry():
+    from tpu_paxos.core import fast
+
+    (entry,) = fast.audit_entries()
+    return entry
+
+
+def test_same_entry_lowered_twice_normalizes_identically(fast_entry):
+    t1 = hlo_norm.normalize(_lower_text(fast_entry))
+    t2 = hlo_norm.normalize(_lower_text(fast_entry))
+    assert t1 == t2
+
+
+# ---------------- donation checker ----------------
+
+def test_expected_donated_params_pytree_offsets():
+    import jax.numpy as jnp
+
+    state = {"a": jnp.zeros((4,), jnp.int32),
+             "b": jnp.zeros((4,), jnp.int32)}
+    x = jnp.zeros((4,), jnp.int32)
+    # donate arg 1: its leaves sit after arg 0's two leaves
+    exp = hlo_audit.expected_donated_params((state, x), (1,))
+    assert sorted(exp) == [2]
+    exp = hlo_audit.expected_donated_params((state, x), (0,))
+    assert sorted(exp) == [0, 1]
+    # a non-array leaf before the donated arg breaks the numbering
+    with pytest.raises(regm.RegistryError, match="all-array"):
+        hlo_audit.expected_donated_params((3, state), (1,))
+
+
+def test_fastwin_entry_donation_is_aliased():
+    # the real donated surface: every FastState leaf must alias
+    from tpu_paxos.core import fastwin
+
+    (entry,) = fastwin.audit_entries()
+    lowered, args = hlo_audit.lower_entry(entry)
+    text = lowered.compile().as_text()
+    assert hlo_audit.check_donation(entry, args, text) == []
+    expected = hlo_audit.expected_donated_params(
+        args, entry.donate_argnums
+    )
+    assert set(expected) <= hlo_norm.aliased_params(text)
+    assert len(expected) == 5  # the five FastState leaves
+
+
+def test_seeded_dropped_donation_fails_named(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_PAXOS_HLO_FIXTURE_DROP_DONATION", "1")
+    provs = jaxpr_audit._load_provider_arg(FIXTURE)
+    report = hlo_audit.run_hlo_audit(
+        providers=provs, budget_path=None,
+        goldens_dir=str(tmp_path / "hlo"),
+        triage_dir=str(tmp_path / "triage"),
+    )
+    assert not report["ok"]
+    assert [(d["entry"], d["param"]) for d in report["donation"]] == [
+        ("hlofix.donated", 0), ("hlofix.donated", 1),
+    ]
+    assert "dropped" in report["donation"][0]["detail"]
+
+
+def test_fixture_clean_donation_passes(tmp_path):
+    provs = jaxpr_audit._load_provider_arg(FIXTURE)
+    report = hlo_audit.run_hlo_audit(
+        providers=provs, budget_path=None,
+        goldens_dir=str(tmp_path / "hlo"),
+        triage_dir=str(tmp_path / "triage"),
+    )
+    assert report["ok"], report["donation"]
+    assert report["entries"]["hlofix.donated"]["aliased_params"] == [0, 1]
+
+
+# ---------------- budgets + goldens (fixture) ----------------
+
+def test_seeded_widening_breaches_budget_and_golden(tmp_path, monkeypatch):
+    bud = str(tmp_path / "hlo_budget.json")
+    gold = str(tmp_path / "hlo")
+    tri = str(tmp_path / "triage")
+    provs = jaxpr_audit._load_provider_arg(FIXTURE)
+    # pin the clean fixture, judge it clean
+    rep = hlo_audit.run_hlo_audit(
+        providers=provs, budget_path=bud, goldens_dir=gold, pin=True,
+        triage_dir=tri,
+    )
+    rep = hlo_audit.run_hlo_audit(
+        providers=provs, budget_path=bud, goldens_dir=gold,
+        triage_dir=tri,
+    )
+    assert rep["ok"], rep["budget"]["violations"]
+    assert rep["entries"]["hlofix.widen"]["golden"] == "ok"
+    # arm the seeded regression
+    monkeypatch.setenv("TPU_PAXOS_HLO_FIXTURE_WIDEN", "1")
+    provs = jaxpr_audit._load_provider_arg(FIXTURE)
+    rep = hlo_audit.run_hlo_audit(
+        providers=provs, budget_path=bud, goldens_dir=gold,
+        triage_dir=tri,
+    )
+    assert not rep["ok"]
+    by_key = {(v["entry"], v["key"]) for v in rep["budget"]["violations"]}
+    assert ("hlofix.widen", "convert") in by_key   # per-primitive cap
+    assert ("hlofix.widen", "golden") in by_key    # golden diff
+    assert rep["entries"]["hlofix.widen"]["golden"] == "mismatch"
+    # breach artifacts: unified diff + compiled text, deterministic names
+    diff = os.path.join(tri, "hlo_hlofix_widen.diff")
+    txt = os.path.join(tri, "hlo_hlofix_widen.txt")
+    assert os.path.exists(diff) and os.path.exists(txt)
+    body = open(diff, encoding="utf-8").read()
+    assert "golden/hlofix.widen" in body and "convert" in body
+
+
+def test_budget_backend_gate_and_staleness():
+    measured = {"e.one": {"hlo_ops": 10, "convert": 1, "mem_bytes": 100}}
+    budget = {
+        "version": 1, "backend": "quantum",
+        "entries": {"e.one": {"hlo_ops": 1}},
+    }
+    v, stale, enforced = hlo_audit.check_budget(measured, budget, "cpu")
+    assert not enforced and not v and not stale  # wrong backend: gated
+    # an empty budget (deleted file) is NOT a silent pass
+    v, stale, enforced = hlo_audit.check_budget(measured, {}, "cpu")
+    assert enforced and [x["cap"] for x in v] == [None]
+    budget["backend"] = "cpu"
+    v, stale, enforced = hlo_audit.check_budget(measured, budget, "cpu")
+    assert enforced
+    assert [x["key"] for x in v] == ["hlo_ops"]
+    # unpinned entries are violations; retired names are stale
+    v2, stale2, _ = hlo_audit.check_budget(
+        {"e.new": {"hlo_ops": 3}}, budget, "cpu"
+    )
+    assert v2[0]["cap"] is None and "no pinned" in v2[0]["detail"]
+    assert stale2 == ["e.one"]
+
+
+def test_save_budget_caps_with_headroom_and_slack(tmp_path):
+    path = str(tmp_path / "b.json")
+    measured = {"e": {"hlo_ops": 100, "convert": 0, "mem_bytes": 1000}}
+    data = hlo_audit.save_budget(measured, path, "cpu", "x.y.z")
+    caps = data["entries"]["e"]
+    assert caps["hlo_ops"] == int(100 * 1.25) + 2
+    assert caps["convert"] == 2  # zero pins at the slack floor
+    assert caps["mem_bytes"] == int(1000 * 1.3) + 4096
+    assert json.load(open(path))["backend"] == "cpu"
+
+
+def test_save_golden_bytes_are_deterministic(tmp_path):
+    gold = str(tmp_path)
+    p1 = hlo_audit.save_golden("a.b", "HloModule x\n", gold)
+    b1 = open(p1, "rb").read()
+    time.sleep(0.05)  # a second save must not embed the new mtime
+    p2 = hlo_audit.save_golden("a.b", "HloModule x\n", gold)
+    assert open(p2, "rb").read() == b1
+    assert hlo_audit.load_golden("a.b", gold) == "HloModule x\n"
+    assert hlo_audit.load_golden("missing", gold) is None
+    with gzip.open(p1, "rt", encoding="utf-8") as fh:
+        assert fh.read() == "HloModule x\n"
+
+
+# ---------------- bounded triage dumps ----------------
+
+def test_dump_names_are_deterministic():
+    assert triage.dump_name("hlo", "sim.run_rounds", "diff") == (
+        "hlo_sim_run_rounds.diff"
+    )
+    assert triage.dump_name("jaxpr", "fleet.run_lanes") == (
+        "jaxpr_fleet_run_lanes.txt"
+    )
+
+
+def test_write_dump_overwrites_not_accumulates(tmp_path):
+    d = str(tmp_path)
+    p1 = triage.write_dump(d, "hlo", "e.same", "one")
+    p2 = triage.write_dump(d, "hlo", "e.same", "two")
+    assert p1 == p2
+    assert os.listdir(d) == ["hlo_e_same.txt"]
+    assert open(p2).read() == "two"
+
+
+def test_retention_cap_prunes_oldest_analysis_dumps(tmp_path):
+    d = str(tmp_path)
+    # a stress repro artifact shares the dir but not the namespace
+    repro = os.path.join(d, "repro_fleet_g0_lane0.json")
+    open(repro, "w").write("{}")
+    for i in range(triage.RETENTION_CAP + 8):
+        p = triage.write_dump(d, "jaxpr", f"e.n{i:03d}", "x")
+        os.utime(p, (1_000_000 + i, 1_000_000 + i))
+    dumps = [n for n in os.listdir(d) if n.startswith("jaxpr_")]
+    assert len(dumps) == triage.RETENTION_CAP
+    # oldest pruned first: the survivors are the newest CAP dumps
+    assert f"jaxpr_e_n{0:03d}.txt" not in dumps
+    assert f"jaxpr_e_n{triage.RETENTION_CAP + 7:03d}.txt" in dumps
+    assert os.path.exists(repro)  # repro artifacts never pruned
+
+
+# ---------------- repo pins ----------------
+
+def test_cheap_repo_entries_within_committed_pins():
+    # the sub-second slice of the registry, enforced fast-tier against
+    # the committed budget + goldens (simkern + fastwin are
+    # golden-pinned; scoped runs skip staleness by design)
+    report = hlo_audit.run_hlo_audit(providers=CHEAP_PROVIDERS)
+    assert report["ok"], json.dumps(
+        {k: report[k] for k in ("donation", "budget")}, indent=1,
+        sort_keys=True, default=str,
+    )
+    assert report["entries"]["fastwin.steady_windows"]["golden"] == "ok"
+    assert report["entries"]["simkern.store_accepts"]["golden"] == "ok"
+
+
+@pytest.mark.slow
+def test_repo_hlo_audit_green():
+    # every registered entry compiled and judged against the committed
+    # hlo_budget.json + tests/data/hlo goldens (~2 min)
+    report = hlo_audit.run_hlo_audit()
+    assert report["ok"], json.dumps(
+        {k: report[k] for k in ("donation", "budget")}, indent=1,
+        sort_keys=True, default=str,
+    )
+    golden = [n for n, e in sorted(report["entries"].items())
+              if e["golden"] != "-"]
+    assert len(golden) == 9 and all(
+        report["entries"][n]["golden"] == "ok" for n in golden
+    ), {n: report["entries"][n]["golden"] for n in golden}
+
+
+# ---------------- CLI (subprocess) ----------------
+
+def _audit(args, env_extra=None, cwd=REPO):
+    from _subproc import scrubbed_env
+
+    env = scrubbed_env(
+        extra_prefixes=("TPU_PAXOS_OP_BUDGET", "TPU_PAXOS_HLO"),
+        JAX_PLATFORMS="cpu", **(env_extra or {}),
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_paxos", "audit", *args],
+        capture_output=True, text=True, timeout=500, cwd=cwd, env=env,
+    )
+
+
+def test_cli_dropped_donation_e2e():
+    p = _audit(
+        ["--hlo-only", "--no-budget", "--providers",
+         "tests/data/hlo_fixture.py"],
+        env_extra={"TPU_PAXOS_HLO_FIXTURE_DROP_DONATION": "1"},
+    )
+    assert p.returncode == 1, p.stdout + p.stderr[-2000:]
+    assert "hlofix.donated" in p.stdout
+    assert "donated parameter" in p.stdout
+    assert "1 donation violations" not in p.stdout  # both params named
+    assert "2 donation violations" in p.stdout
+
+
+@pytest.mark.slow
+def test_cli_widening_e2e_with_triage_dump(tmp_path):
+    bud = str(tmp_path / "hlo_budget.json")
+    gold = str(tmp_path / "hlo")
+    tri = str(tmp_path / "triage")
+    base = ["--hlo-only", "--providers", "tests/data/hlo_fixture.py",
+            "--hlo-budget", bud, "--hlo-goldens", gold,
+            "--triage-dir", tri]
+    p = _audit(base + ["--pin"])
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    p = _audit(base)
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    p = _audit(base, env_extra={"TPU_PAXOS_HLO_FIXTURE_WIDEN": "1"})
+    assert p.returncode == 1, p.stdout + p.stderr[-2000:]
+    assert "hlofix.widen" in p.stdout and "convert" in p.stdout
+    assert "drifted from the pinned golden" in p.stdout
+    assert os.path.exists(os.path.join(tri, "hlo_hlofix_widen.diff"))
+
+
+@pytest.mark.slow
+def test_cli_full_audit_with_hlo_exits_zero():
+    # what `make audit` runs: both tiers over the full registry
+    p = _audit(["--hlo"])
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    assert "0 budget violations" in p.stdout
+    assert "0 donation violations" in p.stdout

@@ -615,3 +615,220 @@ def test_every_rule_documented():
         "DET001", "DET002", "DET003", "DET004",
         "JAX101", "JAX102", "JAX103", "JAX104",
     }
+
+
+# ---------------- --fix scaffolding (analysis/fix.py) ----------------
+
+def _plan(tmp_path, src):
+    from tpu_paxos.analysis import fix
+
+    (tmp_path / "pkg").mkdir(exist_ok=True)
+    (tmp_path / "pkg" / "mod.py").write_text(src)
+    report = lint.run_lint(
+        root=str(tmp_path), paths=["pkg/mod.py"], baseline_path=None
+    )
+    return report, fix.plan_fixes(report, str(tmp_path))
+
+
+def _fixed_text(plans):
+    return plans["pkg/mod.py"][1]
+
+
+def test_fix_det003_wraps_iteration_in_sorted(tmp_path):
+    from tpu_paxos.analysis import fix
+
+    src = (
+        "def emit(items):\n"
+        "    s = set(items)\n"
+        "    out = []\n"
+        "    for x in s:\n"
+        "        out.append(x)\n"
+        "    print(out)\n"
+    )
+    report, plans = _plan(tmp_path, src)
+    assert [f["rule"] for f in report["findings"]] == ["DET003"]
+    assert "    for x in sorted(s):\n" in _fixed_text(plans)
+    fix.apply_fixes(plans, str(tmp_path))
+    report2 = lint.run_lint(
+        root=str(tmp_path), paths=["pkg/mod.py"], baseline_path=None
+    )
+    assert report2["findings"] == []
+
+
+def test_fix_det003_wraps_whole_dict_view_call(tmp_path):
+    src = (
+        "import json\n\n"
+        "def dump(stream, d):\n"
+        "    for k, v in d.items():\n"
+        "        stream.write(json.dumps([k, v]))\n"
+    )
+    _report, plans = _plan(tmp_path, src)
+    assert "    for k, v in sorted(d.items()):\n" in _fixed_text(plans)
+
+
+def test_fix_det003_multiline_expression(tmp_path):
+    src = (
+        "def emit(items, extra):\n"
+        "    for x in set(\n"
+        "        items + extra\n"
+        "    ):\n"
+        "        print(x)\n"
+    )
+    _report, plans = _plan(tmp_path, src)
+    fixed = _fixed_text(plans)
+    assert "    for x in sorted(set(\n" in fixed
+    assert "    )):\n" in fixed
+    # the rewrite must still parse
+    import ast
+
+    ast.parse(fixed)
+
+
+def test_fix_pragma_scaffold_indented_with_todo(tmp_path):
+    from tpu_paxos.analysis import fix
+
+    src = (
+        "import time\n\n"
+        "def log_line(stream, msg):\n"
+        "    stream.write(f'[{time.time()}] {msg}')\n"
+    )
+    report, plans = _plan(tmp_path, src)
+    assert [f["rule"] for f in report["findings"]] == ["DET001"]
+    fixed = _fixed_text(plans)
+    assert (
+        "    # paxlint: allow[DET001] " + fix.TODO_REASON + "\n"
+        "    stream.write(f'[{time.time()}] {msg}')\n"
+    ) in fixed
+    fix.apply_fixes(plans, str(tmp_path))
+    report2 = lint.run_lint(
+        root=str(tmp_path), paths=["pkg/mod.py"], baseline_path=None
+    )
+    assert report2["findings"] == []  # scaffold suppresses until review
+
+
+def test_fix_true_negative_clean_file_no_plans(tmp_path):
+    from tpu_paxos.analysis import fix
+
+    src = "def ok(xs):\n    return sorted(set(xs))\n"
+    report, plans = _plan(tmp_path, src)
+    assert report["findings"] == []
+    assert plans == {}
+    assert fix.render_diff(plans) == ""
+
+
+def test_fix_mixed_findings_apply_bottom_up(tmp_path):
+    from tpu_paxos.analysis import fix
+
+    src = (
+        "import time\n\n"
+        "def emit(items):\n"
+        "    s = set(items)\n"
+        "    for x in s:\n"
+        "        print(x)\n"
+        "    print(time.time())\n"
+    )
+    _report, plans = _plan(tmp_path, src)
+    fixed = _fixed_text(plans)
+    assert "    for x in sorted(s):\n" in fixed
+    assert "    # paxlint: allow[DET001]" in fixed
+    fix.apply_fixes(plans, str(tmp_path))
+    report2 = lint.run_lint(
+        root=str(tmp_path), paths=["pkg/mod.py"], baseline_path=None
+    )
+    assert report2["findings"] == []
+
+
+def test_fix_same_line_wrap_and_pragma_do_not_corrupt(tmp_path):
+    # DET003 and DET001 on ONE line: the pragma insert must not shift
+    # the wrap's coordinates (wraps run first, inserts bottom-up)
+    from tpu_paxos.analysis import fix
+
+    src = (
+        "import time\n\n"
+        "def emit(stream, s):\n"
+        "    for x in s & {1}: stream.write(str(time.time()))\n"
+    )
+    report, plans = _plan(tmp_path, src)
+    assert {f["rule"] for f in report["findings"]} == {
+        "DET001", "DET003"
+    }
+    fixed = _fixed_text(plans)
+    assert "for x in sorted(s & {1}):" in fixed
+    assert "    # paxlint: allow[DET001]" in fixed
+    import ast
+
+    ast.parse(fixed)
+    fix.apply_fixes(plans, str(tmp_path))
+    report2 = lint.run_lint(
+        root=str(tmp_path), paths=["pkg/mod.py"], baseline_path=None
+    )
+    assert report2["findings"] == []
+
+
+def test_fix_skips_unparseable_file_without_crashing(tmp_path):
+    from tpu_paxos.analysis import fix
+
+    (tmp_path / "pkg").mkdir(exist_ok=True)
+    (tmp_path / "pkg" / "bad.py").write_text("def broken(:\n")
+    (tmp_path / "pkg" / "mod.py").write_text(
+        "def emit(xs):\n    for x in set(xs):\n        print(x)\n"
+    )
+    report = lint.run_lint(
+        root=str(tmp_path), paths=["pkg"], baseline_path=None
+    )
+    assert "PARSE" in {f["rule"] for f in report["findings"]}
+    plans = fix.plan_fixes(report, str(tmp_path))  # must not raise
+    assert set(plans) == {"pkg/mod.py"}
+
+
+def test_fix_plan_is_dry_run_and_apply_refuses_stale(tmp_path):
+    import pytest as _pytest
+
+    from tpu_paxos.analysis import fix
+
+    src = "import time\n\ndef f(s):\n    s.write(str(time.time()))\n"
+    _report, plans = _plan(tmp_path, src)
+    path = tmp_path / "pkg" / "mod.py"
+    assert path.read_text() == src  # planning never writes
+    path.write_text(src + "\n# drifted\n")
+    with _pytest.raises(RuntimeError, match="changed since"):
+        fix.apply_fixes(plans, str(tmp_path))
+
+
+def test_fix_stale_apply_writes_nothing_at_all(tmp_path):
+    # staleness in ANY planned file must abort BEFORE the first write
+    # — never leave the tree half-fixed
+    import pytest as _pytest
+
+    from tpu_paxos.analysis import fix
+
+    (tmp_path / "pkg").mkdir()
+    a = "import time\n\ndef f(s):\n    s.write(str(time.time()))\n"
+    b = "import time\n\ndef g(s):\n    s.write(str(time.time()))\n"
+    (tmp_path / "pkg" / "a.py").write_text(a)
+    (tmp_path / "pkg" / "b.py").write_text(b)
+    report = lint.run_lint(
+        root=str(tmp_path), paths=["pkg"], baseline_path=None
+    )
+    plans = fix.plan_fixes(report, str(tmp_path))
+    assert set(plans) == {"pkg/a.py", "pkg/b.py"}
+    (tmp_path / "pkg" / "b.py").write_text(b + "# drifted\n")
+    with _pytest.raises(RuntimeError, match="b.py changed since"):
+        fix.apply_fixes(plans, str(tmp_path))
+    assert (tmp_path / "pkg" / "a.py").read_text() == a  # untouched
+
+
+def test_fix_never_plans_a_corrupting_rewrite(tmp_path):
+    # a finding on a backslash-continuation line: the pragma would
+    # split the continuation — the plan must drop the file, not ship
+    # unimportable code
+    src = (
+        "import time\n\n"
+        "def f(s):\n"
+        "    x = 1 + \\\n"
+        "        time.time()\n"
+        "    s.write(str(x))\n"
+    )
+    report, plans = _plan(tmp_path, src)
+    assert [f["rule"] for f in report["findings"]] == ["DET001"]
+    assert plans == {}

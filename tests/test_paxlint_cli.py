@@ -162,3 +162,64 @@ def test_analysis_imports_and_lints_without_jax():
     )
     assert p.returncode == 0, p.stderr[-3000:]
     assert "JAXFREE_OK" in p.stdout
+
+
+# ---------------- --fix scaffolding (CLI surface) ----------------
+
+FIX_FIXTURE = '''\
+import time
+
+
+def emit(stream, members):
+    for m in set(members):
+        stream.write(str(m))
+    stream.write(str(time.time()))
+'''
+
+
+def test_cli_fix_dry_run_prints_diff_and_leaves_tree(tmp_path):
+    (tmp_path / "fixture.py").write_text(FIX_FIXTURE)
+    p = _lint(
+        ["--fix", "--no-baseline", "--root", str(tmp_path), "fixture.py"],
+        cwd=REPO,
+    )
+    assert p.returncode == 1, p.stderr[-2000:]  # findings still present
+    assert "+    for m in sorted(set(members)):" in p.stdout
+    assert "+    # paxlint: allow[DET001]" in p.stdout
+    assert "dry run" in p.stdout
+    # dry run never writes
+    assert (tmp_path / "fixture.py").read_text() == FIX_FIXTURE
+
+
+def test_cli_fix_write_applies_and_relints_clean(tmp_path):
+    (tmp_path / "fixture.py").write_text(FIX_FIXTURE)
+    p = _lint(
+        ["--fix", "--write", "--no-baseline", "--root", str(tmp_path),
+         "fixture.py"],
+        cwd=REPO,
+    )
+    assert p.returncode == 1, p.stderr[-2000:]
+    assert "fixed: fixture.py" in p.stdout
+    fixed = (tmp_path / "fixture.py").read_text()
+    assert "sorted(set(members))" in fixed
+    assert "# paxlint: allow[DET001] TODO:" in fixed
+    p = _lint(
+        ["--no-baseline", "--root", str(tmp_path), "fixture.py"],
+        cwd=REPO,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr[-2000:]
+    assert "0 findings" in p.stdout
+
+
+def test_cli_write_without_fix_is_an_error(tmp_path):
+    p = _lint(["--write", "--root", str(tmp_path)], cwd=REPO)
+    assert p.returncode == 2
+    assert "--write requires --fix" in p.stderr
+
+
+def test_cli_fix_with_json_is_an_error(tmp_path):
+    # --fix's output is the diff; silently dropping --json would hand
+    # a JSON consumer human text — refuse loudly instead
+    p = _lint(["--fix", "--json", "--root", str(tmp_path)], cwd=REPO)
+    assert p.returncode == 2
+    assert "--fix does not support --json" in p.stderr

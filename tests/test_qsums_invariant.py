@@ -74,6 +74,9 @@ def test_qsums_match_under_iid_faults():
     _check_run(cfg)
 
 
+# Slow tier (time budget): the i.i.d. cell keeps the invariant
+# fast-tier, and the slow multi-seed sweep below covers episode mixes.
+@pytest.mark.slow
 def test_qsums_match_under_episode_schedule():
     """Same assertion through a partition + pause + burst schedule:
     episode masking must not open an un-enumerated mutation path."""

@@ -31,7 +31,16 @@ pytest-recorded artifacts).  This subpackage enforces the contract
   trace-time tier: the auditable-entry-point registry (entries live
   with the engines), IR-level rules IR201-IR205 over the traced
   jaxprs, and the audit driver with the pinned op/cost budget
-  (``op_budget.json``, ``python -m tpu_paxos audit``).
+  (``op_budget.json``, ``python -m tpu_paxos audit``);
+- ``hlo_norm.py`` / ``hlo_audit.py`` / ``triage.py`` — the
+  compiled-artifact tier (``python -m tpu_paxos audit --hlo``):
+  normalized post-optimization HLO goldens for the hot kernels
+  (``tests/data/hlo/``), per-primitive instruction budgets + memory
+  ceilings (``hlo_budget.json``), the donation/aliasing checker, and
+  the bounded deterministic breach-dump namespace shared with IR205;
+- ``fix.py`` — paxlint's ``--fix`` scaffolding: mechanical rewrites
+  (sorted() wraps for DET003, pragma scaffolds with TODO reasons)
+  emitted as a dry-run unified diff, applied with ``--write``.
 
 Import discipline: everything except ``tracecount`` and
 ``jaxpr_audit`` is pure stdlib and MUST import without jax (same lazy
@@ -43,8 +52,9 @@ importing jax).
 """
 
 _SUBMODULES = (
-    "artifact_schema", "ir_rules", "jaxpr_audit", "lint", "registry",
-    "rules_det", "rules_jax", "tracecount",
+    "artifact_schema", "fix", "hlo_audit", "hlo_norm", "ir_rules",
+    "jaxpr_audit", "lint", "registry", "rules_det", "rules_jax",
+    "tracecount", "triage",
 )
 
 

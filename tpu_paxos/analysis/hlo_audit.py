@@ -1,0 +1,469 @@
+"""hlo-audit: compiled-artifact contracts for the registered entries.
+
+The third static-analysis tier.  paxlint reads source (AST), the
+jaxpr audit reads the traced IR — both stop above the compiler, so a
+fusion break, a silently-copied donated buffer, or padding waste from
+an envelope bound shows up only as an unexplained lanes/sec
+regression.  This tier lowers every :class:`~tpu_paxos.analysis.
+registry.AuditEntry` through the product's own jit surface, compiles
+it, and holds the *compiled module* to three contracts:
+
+1. **Normalized HLO goldens** (hot kernels, ``entry.hlo_golden``):
+   the post-optimization module text, normalized by ``hlo_norm``
+   (ids/metadata/layout noise stripped), must match the pinned golden
+   under ``tests/data/hlo/`` byte-for-byte.  A mismatch dumps a
+   unified diff to ``stress-triage/`` (the IR205 convention) and
+   fails naming the entry.  Re-pin: ``TPU_PAXOS_HLO_PIN=1 make
+   audit`` (or ``--pin``); commit the golden diff.
+2. **Per-primitive budgets + memory ceilings** (every entry):
+   instruction counts for the regression-prone families (fusion /
+   copy / convert / transpose / while) and peak buffer bytes
+   (``compiled.memory_analysis()``; ``cost_analysis`` bytes where
+   unavailable) against ``analysis/hlo_budget.json`` with the same
+   headroom+slack+re-pin machinery as ``op_budget.json``.  Compiled
+   text is backend-shaped, so enforcement is gated on the pinning
+   backend — like the flops/bytes pins of the jaxpr tier.
+3. **Donation/aliasing checker** (entries with ``donate_argnums``):
+   every array leaf of a donated argument must appear as an
+   ``input_output_alias`` parameter in the compiled module header.
+   This one is enforced on EVERY backend: a donation dropped behind a
+   flag or lost in a wrapper re-jit is a doubled buffer wherever it
+   compiles, and the serving harness's double-buffered queue state
+   (ROADMAP item 1) rides on this guarantee.
+
+``python -m tpu_paxos audit --hlo`` (what ``make audit`` runs) adds
+this tier after the jaxpr tier; ``--hlo-only`` runs it alone.
+Tier-1 enforcement lives in ``tests/test_hlo_audit.py`` (the full
+golden sweep is slow-tier; the cheap entries run fast-tier).
+
+Import discipline: jax only inside the lowering functions;
+``hlo_norm`` and the budget/golden machinery stay jax-free so a raw
+text dump can be re-judged in a jax-free image.
+"""
+
+from __future__ import annotations
+
+import difflib
+import gzip
+import json
+import os
+
+from tpu_paxos.analysis import hlo_norm, triage
+from tpu_paxos.analysis import registry as regm
+
+DEFAULT_BUDGET = os.path.join(os.path.dirname(__file__), "hlo_budget.json")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+#: Goldens live with the other pinned test data, one gzip per entry
+#: (normalized text is ~1 MB for the big drivers; gzip with mtime=0
+#: keeps the committed bytes deterministic).
+DEFAULT_GOLDEN_DIR = os.path.join(_REPO, "tests", "data", "hlo")
+
+PIN_ENV = "TPU_PAXOS_HLO_PIN"
+
+#: Budget caps: count keys get ceil(v*(1+headroom))+slack; the memory
+#: ceiling gets its own (looser) pair — allocator jitter is coarser
+#: than instruction-count jitter.
+HEADROOM, SLACK = 0.25, 2
+MEM_HEADROOM, MEM_SLACK = 0.3, 4096
+
+#: Max unified-diff lines dumped per golden breach (the full normalized
+#: text is megabytes; the head of the diff names the divergence).
+DIFF_CAP = 400
+
+
+# ---------------- lowering ----------------
+
+def lower_entry(entry):
+    """-> (lowered, args) via the entry's canonical call.  Entries
+    with ``hlo_build`` lower through the product's own jitted callable
+    (donation must not be re-added by a wrapper jit); the rest reuse
+    the jaxpr-tier ``build()``."""
+    import jax
+
+    if entry.hlo_build is not None:
+        lowerable, args, kwargs = entry.hlo_build()
+    else:
+        fn, args = entry.build()
+        kwargs = {}
+        lowerable = fn if hasattr(fn, "lower") else jax.jit(fn)
+    if entry.x64:
+        import jax.experimental
+
+        with jax.experimental.enable_x64():
+            return lowerable.lower(*args, **kwargs), args
+    return lowerable.lower(*args, **kwargs), args
+
+
+def expected_donated_params(args, donate_argnums) -> dict[int, str]:
+    """Flattened parameter numbers the compiled module must alias:
+    donated args' array leaves, numbered by position among all array
+    leaves of the positional args.  Non-array leaves are assumed
+    static (consumed by static_argnames, no parameter) — sound only
+    when every arg up to the last donated one is all-array, which
+    :func:`run_hlo_audit` verifies."""
+    import jax
+
+    expected: dict[int, str] = {}
+    offset = 0
+    last_donated = max(donate_argnums, default=-1)
+    for i, arg in enumerate(args):
+        leaves = jax.tree.leaves(arg)
+        arrays = [
+            leaf for leaf in leaves
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+        ]
+        if i <= last_donated and len(arrays) != len(leaves):
+            raise regm.RegistryError(
+                f"donation accounting needs all-array args up to arg "
+                f"{last_donated} (arg {i} has non-array leaves) — "
+                "reorder the entry's canonical call or drop "
+                "donate_argnums"
+            )
+        if i in donate_argnums:
+            for j, leaf in enumerate(arrays):
+                expected[offset + j] = (
+                    f"arg {i} leaf {j} "
+                    f"({getattr(leaf, 'dtype', '?')}"
+                    f"{list(getattr(leaf, 'shape', ()))})"
+                )
+        offset += len(arrays)
+    return expected
+
+
+def memory_ceiling(compiled) -> dict:
+    """Peak buffer bytes of the compiled executable: argument +
+    output + temp, minus aliased (donated buffers are not double
+    counted).  Falls back to cost_analysis 'bytes accessed' where the
+    backend has no memory_analysis."""
+    try:
+        ma = compiled.memory_analysis()
+        total = int(
+            ma.argument_size_in_bytes + ma.output_size_in_bytes
+            + ma.temp_size_in_bytes - ma.alias_size_in_bytes
+        )
+        return {"mem_bytes": total, "mem_source": "memory_analysis"}
+    except Exception:
+        pass
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict) and ca.get("bytes accessed"):
+            return {
+                "mem_bytes": int(ca["bytes accessed"]),
+                "mem_source": "cost_analysis",
+            }
+    except Exception:
+        pass
+    return {"mem_bytes": 0, "mem_source": "unavailable"}
+
+
+# ---------------- goldens ----------------
+
+def golden_path(name: str, goldens_dir: str = DEFAULT_GOLDEN_DIR) -> str:
+    return os.path.join(
+        goldens_dir, triage.dump_name("golden", name, "hlo.gz")
+    )
+
+
+def load_golden(name: str, goldens_dir: str = DEFAULT_GOLDEN_DIR
+                ) -> str | None:
+    path = golden_path(name, goldens_dir)
+    if not os.path.exists(path):
+        return None
+    with gzip.open(path, "rt", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def save_golden(name: str, text: str,
+                goldens_dir: str = DEFAULT_GOLDEN_DIR) -> str:
+    os.makedirs(goldens_dir, exist_ok=True)
+    path = golden_path(name, goldens_dir)
+    tmp = path + ".tmp"
+    # mtime=0 → byte-identical gzip for identical text (re-pinning an
+    # unchanged golden produces no diff)
+    with open(tmp, "wb") as raw:
+        with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as fh:
+            fh.write(text.encode("utf-8"))
+    os.replace(tmp, path)
+    return path
+
+
+def golden_diff(want: str, got: str, name: str) -> str:
+    """Bounded unified diff (golden vs measured) for the triage dump."""
+    lines = list(difflib.unified_diff(
+        want.splitlines(), got.splitlines(),
+        fromfile=f"golden/{name}", tofile=f"compiled/{name}", lineterm="",
+    ))
+    clipped = lines[:DIFF_CAP]
+    if len(lines) > DIFF_CAP:
+        clipped.append(
+            f"... diff clipped at {DIFF_CAP} of {len(lines)} lines "
+            f"(re-pin: {PIN_ENV}=1 make audit)"
+        )
+    return "\n".join(clipped) + "\n"
+
+
+# ---------------- budget ----------------
+
+_COUNT_KEYS = ("hlo_ops",) + hlo_norm.SUMMARY_KEYS
+
+
+def load_budget(path: str = DEFAULT_BUDGET) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_budget(measured: dict[str, dict], path: str, backend: str,
+                jax_version: str, keep: dict | None = None) -> dict:
+    """Pin the measured census with headroom+slack (op_budget.json
+    semantics; ``keep`` preserves entries a scoped pin did not trace)."""
+    entries = dict(keep or {})
+    for name, m in sorted(measured.items()):
+        caps = {
+            k: int(m[k] * (1 + HEADROOM)) + SLACK
+            for k in _COUNT_KEYS if k in m
+        }
+        if m.get("mem_bytes"):
+            caps["mem_bytes"] = (
+                int(m["mem_bytes"] * (1 + MEM_HEADROOM)) + MEM_SLACK
+            )
+        entries[name] = caps
+    data = {
+        "version": 1,
+        "backend": backend,
+        "jax": jax_version,
+        "headroom": HEADROOM,
+        "slack": SLACK,
+        "mem_headroom": MEM_HEADROOM,
+        "mem_slack": MEM_SLACK,
+        "entries": dict(sorted(entries.items())),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def check_budget(measured: dict[str, dict], budget: dict,
+                 backend: str) -> tuple[list[dict], list[str], bool]:
+    """-> (violations, stale, enforced).  Compiled text is
+    backend-shaped, so nothing is enforced against a budget pinned on
+    a different backend (enforced=False) — mirroring the flops/bytes
+    gate of the jaxpr tier.  On the pinning backend, unpinned entries
+    are violations (nothing stays uncapped) and entries for names no
+    longer registered are stale."""
+    entries: dict = budget.get("entries", {})
+    if budget and budget.get("backend") != backend:
+        return [], [], False
+    # an EMPTY budget (missing/deleted file) is not a pass: every
+    # entry reports unpinned below — nothing stays uncapped
+    violations: list[dict] = []
+    for name in sorted(measured):
+        m = measured[name]
+        caps = entries.get(name)
+        if caps is None:
+            violations.append({
+                "entry": name, "key": "hlo_ops",
+                "measured": m.get("hlo_ops", 0), "cap": None,
+                "detail": f"entry {name} has no pinned HLO budget — "
+                f"re-pin hlo_budget.json ({PIN_ENV}=1)",
+            })
+            continue
+        for key in _COUNT_KEYS + ("mem_bytes",):
+            if key in m and key in caps and m[key] > caps[key]:
+                violations.append({
+                    "entry": name, "key": key, "measured": m[key],
+                    "cap": caps[key],
+                    "detail": (
+                        f"entry {name}: {m[key]} {key} > budget "
+                        f"{caps[key]} (+{m[key] - caps[key]}) — the "
+                        "compiled module grew; if intentional, re-pin "
+                        f"hlo_budget.json ({PIN_ENV}=1)"
+                    ),
+                })
+    stale = [n for n in sorted(entries) if n not in measured]
+    return violations, stale, True
+
+
+# ---------------- the audit ----------------
+
+def check_donation(entry, args, text: str) -> list[dict]:
+    """Donation contract for one entry: every expected donated
+    parameter must appear in the compiled header's alias table."""
+    if not entry.donate_argnums:
+        return []
+    expected = expected_donated_params(args, entry.donate_argnums)
+    got = hlo_norm.aliased_params(text)
+    problems = []
+    for param in sorted(set(expected) - got):
+        problems.append({
+            "entry": entry.name, "param": param,
+            "detail": (
+                f"entry {entry.name}: donated parameter {param} "
+                f"[{expected[param]}] is NOT aliased to any output in "
+                "the compiled module — the donation was dropped "
+                "(check the jit's donate_argnums and any wrapper "
+                "re-jit); the buffer is silently doubled"
+            ),
+        })
+    return problems
+
+
+def run_hlo_audit(
+    providers=regm.AUDIT_PROVIDERS,
+    budget_path: str | None = DEFAULT_BUDGET,
+    goldens_dir: str = DEFAULT_GOLDEN_DIR,
+    pin: bool = False,
+    triage_dir: str = "stress-triage",
+) -> dict:
+    """Compile every registered entry and enforce the three compiled-
+    artifact contracts.  Returns a JSON-ready report; ``ok`` iff
+    donation clean AND (pinning, or budget+goldens clean / not
+    enforceable on this backend)."""
+    import jax
+
+    backend = jax.default_backend()
+    jax_version = jax.__version__
+    entries = regm.collect(providers)
+    full = tuple(providers) == tuple(regm.AUDIT_PROVIDERS)
+
+    measured: dict[str, dict] = {}
+    texts: dict[str, str] = {}
+    report_entries: dict[str, dict] = {}
+    donation: list[dict] = []
+    dumped: list[str] = []
+    golden_status: dict[str, str] = {}
+    golden_texts: dict[str, str] = {}
+
+    for entry in entries:
+        lowered, args = lower_entry(entry)
+        compiled = lowered.compile()
+        text = compiled.as_text() or ""
+        norm = hlo_norm.normalize(text)
+        texts[entry.name] = norm
+        hist = hlo_norm.histogram_summary(hlo_norm.opcode_histogram(norm))
+        hist.update(memory_ceiling(compiled))
+        measured[entry.name] = hist
+        donation.extend(check_donation(entry, args, text))
+        if entry.hlo_golden:
+            golden_texts[entry.name] = norm
+        report_entries[entry.name] = dict(hist) | {
+            "aliased_params": sorted(hlo_norm.aliased_params(text)),
+            "golden": "pinned" if entry.hlo_golden else "-",
+        }
+
+    budget = load_budget(budget_path) if budget_path else {}
+    violations: list[dict] = []
+    stale: list[str] = []
+    stale_goldens: list[str] = []
+    enforced = False
+    backend_mismatch = bool(budget) and budget.get("backend") != backend
+
+    if pin:
+        path = budget_path or DEFAULT_BUDGET
+        existing = load_budget(path)
+        keep = None if full else {
+            n: caps for n, caps in existing.get("entries", {}).items()
+            if n not in measured
+            and existing.get("backend") == backend
+        }
+        save_budget(measured, path, backend, jax_version, keep=keep)
+        for name, norm in sorted(golden_texts.items()):
+            save_golden(name, norm, goldens_dir)
+        if full and os.path.isdir(goldens_dir):
+            want = {os.path.basename(golden_path(n, goldens_dir))
+                    for n in golden_texts}
+            for fname in sorted(os.listdir(goldens_dir)):
+                if fname.endswith(".hlo.gz") and fname not in want:
+                    os.remove(os.path.join(goldens_dir, fname))
+    else:
+        if budget_path:
+            violations, stale, enforced = check_budget(
+                measured, budget, backend
+            )
+            if not full:
+                stale = []  # scoped runs never traced the rest
+        if budget_path and enforced:
+            # goldens ride the budget's backend gate;
+            # budget_path=None (--no-budget) skips goldens like every
+            # other pin — donation-only mode
+            for name, norm in sorted(golden_texts.items()):
+                want = load_golden(name, goldens_dir)
+                if want is None:
+                    golden_status[name] = "unpinned"
+                    violations.append({
+                        "entry": name, "key": "golden", "measured": None,
+                        "cap": None,
+                        "detail": f"entry {name} is golden-pinned but "
+                        f"has no committed golden under {goldens_dir} "
+                        f"— re-pin ({PIN_ENV}=1)",
+                    })
+                elif want != norm:
+                    golden_status[name] = "mismatch"
+                    diff = golden_diff(want, norm, name)
+                    try:
+                        dumped.append(triage.write_dump(
+                            triage_dir, "hlo", name, diff, ext="diff"
+                        ))
+                    except OSError:
+                        pass  # read-only checkout must not mask it
+                    violations.append({
+                        "entry": name, "key": "golden", "measured": None,
+                        "cap": None,
+                        "detail": (
+                            f"entry {name}: normalized compiled HLO "
+                            "drifted from the pinned golden — the "
+                            "compiled program changed structurally; "
+                            "diff dumped; if intentional, re-pin "
+                            f"({PIN_ENV}=1)"
+                        ),
+                    })
+                else:
+                    golden_status[name] = "ok"
+            if full and os.path.isdir(goldens_dir):
+                want = {os.path.basename(golden_path(n, goldens_dir))
+                        for n in golden_texts}
+                stale_goldens = [
+                    fname for fname in sorted(os.listdir(goldens_dir))
+                    if fname.endswith(".hlo.gz") and fname not in want
+                ]
+        for name, status in golden_status.items():
+            report_entries[name]["golden"] = status
+
+    for v in violations:
+        name = v["entry"]
+        if v["key"] != "golden" and name in texts:
+            try:
+                dumped.append(triage.write_dump(
+                    triage_dir, "hlo", name, texts[name], ext="txt"
+                ))
+            except OSError:
+                pass
+
+    report = {
+        "version": 1,
+        "backend": backend,
+        "jax": jax_version,
+        "enforced": bool(enforced),
+        "backend_mismatch": backend_mismatch,
+        "entries": dict(sorted(report_entries.items())),
+        "donation": donation,
+        "budget": {
+            "path": budget_path or "",
+            "pinned": bool(pin),
+            "violations": violations,
+            "stale": stale,
+            "stale_goldens": stale_goldens,
+            "dumped": sorted(set(dumped)),
+        },
+        "ok": not donation and not violations and not stale
+        and not stale_goldens,
+    }
+    return report

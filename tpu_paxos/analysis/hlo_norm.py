@@ -1,0 +1,226 @@
+"""Compiled-HLO text normalizer for the hlo-audit tier (pure stdlib).
+
+XLA's post-optimization module text (``lowered.compile().as_text()``)
+is the artifact the third analysis tier pins — but raw, it is full of
+noise that churns without any semantic change: global value-numbering
+suffixes (``%add.14201``), per-op ``metadata={op_name=... source_line=...}``
+provenance, minor-to-major layout braces (``s32[3,16]{1,0}``), and
+``/*index=N*/`` pretty-printer comments.  :func:`normalize` strips all
+of that and renumbers every ``%`` identifier per base name in order of
+first appearance, so
+
+- the same entry lowered twice normalizes byte-identically,
+- a pure metadata / numbering / layout perturbation normalizes away,
+- a *structural* change (an extra ``convert``, a broken fusion, a
+  dropped ``input_output_alias``) does NOT — it shows up as a readable
+  unified diff against the pinned golden.
+
+The module header keeps exactly two load-bearing facts: the module
+name and the ``input_output_alias`` table (the donation checker's
+evidence).  Everything else on the header line (schedules, layouts,
+SPMD propagation flags) is dropped.
+
+Also here, because they parse the same text:
+
+- :func:`opcode_histogram` — per-primitive instruction counts (the
+  fusion / copy / convert / transpose / while census the per-entry
+  HLO budget caps).
+- :func:`alias_table` — the parsed ``input_output_alias`` entries
+  (output index, parameter number, kind) the donation checker reads.
+
+No jax import anywhere in this module: it must run on a raw text dump
+(e.g. a triage artifact) in a jax-free CI image.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = [
+    "normalize", "opcode_histogram", "histogram_summary", "alias_table",
+    "aliased_params",
+]
+
+_INDEX_COMMENT = re.compile(r"/\*index=\d+\*/\s?")
+#: minor-to-major layout braces directly after a shape: ``s32[3,16]{1,0}``
+#: (TPU adds tiling after a colon: ``{1,0:T(8,128)}``) — never the brace
+#: opening a computation body, which follows ``)`` or whitespace.
+_LAYOUT = re.compile(r"(\[[0-9,]*\])\{[0-9,]*(?::[^}]*)?\}")
+#: every %-identifier (with or without a value-numbering suffix), plus
+#: bare ``name.N`` tokens — computation signatures print parameter ids
+#: without the ``%`` sigil (``(param_0.2: u32[], ...)``).  Floats never
+#: match: the base must start with a letter or underscore.
+_IDENT = re.compile(r"%?[A-Za-z_][\w-]*\.\d+|%[A-Za-z_][\w-]*\b")
+#: the quoted-string form of backend_config (proto bytes / b64).
+_BACKEND_CONFIG_STR = re.compile(r",?\s*backend_config=\"(?:[^\"\\]|\\.)*\"")
+_ALIAS_ENTRY = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*([\w-]+)\)"
+)
+#: ``%id = <type> opcode(...`` — type is a scalar/array form or a
+#: ``(tuple, of, types)``; opcode is the lower-case instruction name.
+_INSTR = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.-]+\s*=\s*"
+    r"(?:\([^)]*\)|[\w!\[\],]+)\s+"
+    r"([a-z][a-z0-9-]*)\("
+)
+
+
+def _extract_attr(line: str, attr: str) -> str | None:
+    """The brace-balanced body of ``attr={...}`` in ``line`` (the
+    alias table nests braces: ``{ {0}: (0, {}, may-alias) }``)."""
+    key = attr + "={"
+    start = line.find(key)
+    if start < 0:
+        return None
+    i = start + len(key)
+    depth = 1
+    while i < len(line) and depth:
+        if line[i] == "{":
+            depth += 1
+        elif line[i] == "}":
+            depth -= 1
+        i += 1
+    return line[start + len(key):i - 1]
+
+
+def _strip_attr(line: str, attr: str) -> str:
+    """Remove ``attr={...}`` (with the preceding ``, `` if any) from a
+    line, brace- and quote-aware — op_name strings may contain braces
+    (jaxpr pretty-printed params leak into provenance)."""
+    key = attr + "={"
+    out = line
+    while True:
+        start = out.find(key)
+        if start < 0:
+            return out
+        i = start + len(key)
+        depth, in_str = 1, False
+        while i < len(out) and depth:
+            ch = out[i]
+            if in_str:
+                if ch == '"' and out[i - 1] != "\\":
+                    in_str = False
+            elif ch == '"':
+                in_str = True
+            elif ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+            i += 1
+        cut_from = start
+        # also eat the separator before the attribute
+        pre = out[:start].rstrip()
+        if pre.endswith(","):
+            cut_from = len(pre) - 1
+        out = out[:cut_from] + out[i:]
+
+
+def _normalize_header(line: str) -> str:
+    """``HloModule <name>`` + the alias table; all other header fields
+    (is_scheduled, entry_computation_layout, SPMD flags, ...) are
+    compiler bookkeeping, not program structure."""
+    name = line.split(",", 1)[0].strip()
+    # the module name itself can carry a numbering suffix
+    name = re.sub(r"\.\d+$", "", name)
+    alias = _extract_attr(line, "input_output_alias")
+    if alias is not None:
+        return f"{name}, input_output_alias={{{alias.strip()}}}"
+    return name
+
+
+def normalize(text: str) -> str:
+    """Normalize one compiled HLO module's text (see module doc)."""
+    lines = text.splitlines()
+    out: list[str] = []
+    counters: dict[str, int] = {}
+    mapping: dict[str, str] = {}
+
+    def canon(m: re.Match) -> str:
+        tok = m.group(0)
+        pct = "%" if tok.startswith("%") else ""
+        key = tok.lstrip("%")  # %add.5 and bare add.5 are one value
+        got = mapping.get(key)
+        if got is None:
+            base = key.rsplit(".", 1)[0] if "." in key else key
+            n = counters.get(base, 0)
+            counters[base] = n + 1
+            got = mapping[key] = f"{base}.{n}"
+        return pct + got
+
+    for i, line in enumerate(lines):
+        if i == 0 and line.startswith("HloModule"):
+            out.append(_normalize_header(line))
+            continue
+        line = _INDEX_COMMENT.sub("", line)
+        line = _strip_attr(line, "metadata")
+        # backend_config is scheduling bookkeeping, not program
+        # structure — on CPU it records the intra-op parallelism split
+        # ("outer_dimension_partitions"), which tracks the host's
+        # core/device provisioning, not the traced program
+        line = _strip_attr(line, "backend_config")
+        line = _BACKEND_CONFIG_STR.sub("", line)
+        line = _LAYOUT.sub(r"\1", line)
+        line = _IDENT.sub(canon, line)
+        out.append(line.rstrip())
+    # collapse the blank-line runs the attribute stripping can leave
+    norm: list[str] = []
+    for line in out:
+        if line == "" and norm and norm[-1] == "":
+            continue
+        norm.append(line)
+    return "\n".join(norm).strip() + "\n"
+
+
+def opcode_histogram(text: str) -> dict[str, int]:
+    """Instruction counts per HLO opcode (works on raw or normalized
+    text — the instruction grammar survives normalization)."""
+    hist: dict[str, int] = {}
+    for line in text.splitlines():
+        m = _INSTR.match(line)
+        if m:
+            op = m.group(1)
+            hist[op] = hist.get(op, 0) + 1
+    return dict(sorted(hist.items()))
+
+
+#: The budget-bearing histogram keys: total instruction count plus the
+#: regression-prone families — fusion breaks show as fusion-count
+#: drift, silent copies/converts/transposes as their own counts, and
+#: loop-structure changes (an unrolled scan, a split while) as the
+#: while count.
+SUMMARY_KEYS = ("fusion", "copy", "convert", "transpose", "while")
+
+
+def histogram_summary(hist: dict[str, int]) -> dict[str, int]:
+    """Reduce a full opcode histogram to the budgeted keys.  ``copy``
+    folds in async copy pairs; every key is always present so a pin at
+    0 means "this family is absent" and any appearance breaches."""
+    out = {"hlo_ops": sum(hist.values())}
+    for key in SUMMARY_KEYS:
+        out[key] = hist.get(key, 0)
+    out["copy"] += hist.get("copy-start", 0) + hist.get("copy-done", 0)
+    return out
+
+
+def alias_table(text: str) -> list[dict]:
+    """Parse the header's ``input_output_alias`` into
+    ``[{output, param, kind}, ...]`` (empty = no donation survived
+    compilation)."""
+    header = text.splitlines()[0] if text else ""
+    body = _extract_attr(header, "input_output_alias")
+    if body is None:
+        return []
+    out = []
+    for om, pm, kind in _ALIAS_ENTRY.findall(body):
+        out.append({
+            "output": tuple(int(x) for x in om.replace(",", " ").split()),
+            "param": int(pm),
+            "kind": kind,
+        })
+    return out
+
+
+def aliased_params(text: str) -> set[int]:
+    """Parameter numbers that alias some output in the compiled
+    module — the donation checker's ground truth."""
+    return {a["param"] for a in alias_table(text)}

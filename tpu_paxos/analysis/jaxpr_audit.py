@@ -315,16 +315,13 @@ def check_budget(measured: dict[str, dict], budget: dict,
 def dump_jaxpr(name: str, closed_jaxpr, triage_dir: str) -> str:
     """Write the offending entry's jaxpr text under the triage dir
     (the repro-artifact convention) so a budget breach is diffable
-    against a clean checkout without rerunning the audit."""
-    os.makedirs(triage_dir, exist_ok=True)
-    path = os.path.join(
-        triage_dir, f"jaxpr_{name.replace('/', '_').replace('.', '_')}.txt"
-    )
-    with open(path, "w", encoding="utf-8") as fh:
-        fh.write(f"# jaxpr audit dump: entry {name}\n")
-        fh.write(str(closed_jaxpr))
-        fh.write("\n")
-    return path
+    against a clean checkout without rerunning the audit.  Routed
+    through ``analysis/triage.py``: deterministic filename, namespace
+    retention cap."""
+    from tpu_paxos.analysis import triage
+
+    text = f"# jaxpr audit dump: entry {name}\n{closed_jaxpr}\n"
+    return triage.write_dump(triage_dir, "jaxpr", name, text)
 
 
 # ---------------- the audit ----------------
@@ -484,6 +481,19 @@ def main(argv=None) -> int:
                     default="auto",
                     help="jax platform for tracing (ops counts are "
                     "backend-independent; flops/bytes pins are not)")
+    ap.add_argument("--hlo", action="store_true",
+                    help="also run the compiled-artifact tier "
+                    "(analysis/hlo_audit.py): normalized-HLO goldens, "
+                    "per-primitive budgets, memory ceilings, donation "
+                    "checker")
+    ap.add_argument("--hlo-only", action="store_true",
+                    help="run ONLY the compiled-artifact tier")
+    ap.add_argument("--hlo-budget", default=None,
+                    help="HLO budget file (default: "
+                    "analysis/hlo_budget.json)")
+    ap.add_argument("--hlo-goldens", default=None,
+                    help="golden dir for normalized compiled HLO "
+                    "(default: tests/data/hlo)")
     args = ap.parse_args(argv)
 
     if args.rules:
@@ -524,16 +534,54 @@ def main(argv=None) -> int:
     pin = not args.no_budget and (
         args.pin or os.environ.get(PIN_ENV, "") not in ("", "0")
     )
-    try:
-        report = run_audit(
-            providers=providers,
-            budget_path=None if args.no_budget else args.budget,
-            pin=pin,
-            triage_dir=args.triage_dir,
-        )
-    except regm.RegistryError as e:
-        print(f"jaxpr-audit: {e}")
-        return 2
+    from tpu_paxos.analysis import hlo_audit
+
+    hlo_pin = not args.no_budget and (
+        args.pin
+        or os.environ.get(hlo_audit.PIN_ENV, "") not in ("", "0")
+    )
+    # an exported HLO pin implies running the tier it re-pins
+    run_hlo = args.hlo or args.hlo_only or (
+        os.environ.get(hlo_audit.PIN_ENV, "") not in ("", "0")
+    )
+    hreport = None
+    report = None
+    if not args.hlo_only:
+        try:
+            report = run_audit(
+                providers=providers,
+                budget_path=None if args.no_budget else args.budget,
+                pin=pin,
+                triage_dir=args.triage_dir,
+            )
+        except regm.RegistryError as e:
+            print(f"jaxpr-audit: {e}")
+            return 2
+    if run_hlo:
+        try:
+            hreport = hlo_audit.run_hlo_audit(
+                providers=providers,
+                budget_path=(
+                    None if args.no_budget
+                    else args.hlo_budget or hlo_audit.DEFAULT_BUDGET
+                ),
+                goldens_dir=args.hlo_goldens or hlo_audit.DEFAULT_GOLDEN_DIR,
+                pin=hlo_pin,
+                triage_dir=args.triage_dir,
+            )
+        except regm.RegistryError as e:
+            print(f"hlo-audit: {e}")
+            return 2
+    if args.hlo_only:
+        if args.json:
+            print(json.dumps(hreport, indent=1, sort_keys=True))
+        else:
+            _print_hlo(hreport, hlo_pin)
+        return 0 if hreport["ok"] else 1
+    if hreport is not None:
+        report = dict(report)
+        report["hlo"] = hreport
+        report["ok"] = report["ok"] and hreport["ok"]
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:
@@ -564,4 +612,42 @@ def main(argv=None) -> int:
             f"{len(report['sweep'])} sweep problems, "
             f"{len(report['budget']['violations'])} budget violations"
         )
+        if hreport is not None:
+            _print_hlo(hreport, hlo_pin)
     return 0 if report["ok"] else 1
+
+
+def _print_hlo(hreport: dict, pinned: bool) -> None:
+    """Human-readable epilogue for the compiled-artifact tier."""
+    from tpu_paxos.analysis import hlo_audit
+
+    for d in hreport["donation"]:
+        print(f"hlo donation: {d['detail']}")
+    for v in hreport["budget"]["violations"]:
+        print(f"hlo budget: {v['detail']}")
+    for d in hreport["budget"]["dumped"]:
+        print(f"    hlo artifact dumped: {d}")
+    for s in hreport["budget"]["stale"]:
+        print(f"hlo budget: stale entry {s} — no longer registered; "
+              f"re-pin hlo_budget.json ({hlo_audit.PIN_ENV}=1)")
+    for s in hreport["budget"]["stale_goldens"]:
+        print(f"hlo golden: stale file {s} — no longer golden-pinned; "
+              f"re-pin ({hlo_audit.PIN_ENV}=1)")
+    if pinned:
+        print(
+            f"hlo budget + goldens pinned "
+            f"({len(hreport['entries'])} entries, backend "
+            f"{hreport['backend']})"
+        )
+    if hreport.get("backend_mismatch"):
+        print(
+            "hlo-audit: budget pinned on a different backend — "
+            "histogram/memory/golden enforcement skipped "
+            "(donation checker still ran)"
+        )
+    print(
+        f"hlo-audit: {len(hreport['entries'])} entry points, "
+        f"{len(hreport['donation'])} donation violations, "
+        f"{len(hreport['budget']['violations'])} budget/golden "
+        f"violations"
+    )

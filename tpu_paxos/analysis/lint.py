@@ -508,7 +508,19 @@ def main(argv=None) -> int:
                     help="ignore the baseline (report everything)")
     ap.add_argument("--rules", action="store_true",
                     help="list rule ids and exit")
+    ap.add_argument("--fix", action="store_true",
+                    help="emit mechanical rewrites for the findings "
+                    "(sorted() wraps for DET003, pragma scaffolds "
+                    "with TODO reasons elsewhere) as a unified diff")
+    ap.add_argument("--write", action="store_true",
+                    help="with --fix: apply the rewrites in place "
+                    "instead of printing the diff")
     args = ap.parse_args(argv)
+    if args.write and not args.fix:
+        ap.error("--write requires --fix")
+    if args.fix and args.json:
+        ap.error("--fix does not support --json (the diff IS the "
+                 "output; run a plain --json pass for the report)")
     if args.rules:
         from tpu_paxos.analysis import rules_det, rules_jax  # noqa: F401
 
@@ -524,6 +536,36 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"paxlint: {e}")
         return 2
+    if args.fix:
+        from tpu_paxos.analysis import fix as fixm
+
+        plans = fixm.plan_fixes(report, args.root)
+        if args.write:
+            try:
+                written = fixm.apply_fixes(plans, args.root)
+            except RuntimeError as e:
+                print(f"paxlint --fix: {e}")
+                return 2
+            for rel in written:
+                print(f"fixed: {rel}")
+            print(
+                f"paxlint --fix: {len(written)} file"
+                f"{'s' if len(written) != 1 else ''} rewritten — "
+                "re-run `make lint`; replace every scaffolded TODO "
+                "reason before review"
+            )
+        else:
+            diff = fixm.render_diff(plans)
+            if diff:
+                print(diff, end="")
+            print(
+                f"paxlint --fix (dry run): {len(plans)} file"
+                f"{'s' if len(plans) != 1 else ''} would change — "
+                "apply with `lint --fix --write`"
+            )
+        # fix mode reports what it would do; the exit code still
+        # reflects the tree as it stands
+        return 0 if report["ok"] else 1
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:

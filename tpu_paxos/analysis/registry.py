@@ -32,6 +32,7 @@ from typing import Callable
 AUDIT_PROVIDERS = (
     "tpu_paxos.core.sim",
     "tpu_paxos.core.simkern",
+    "tpu_paxos.core.fastwin",
     "tpu_paxos.core.fast",
     "tpu_paxos.membership.engine",
     "tpu_paxos.parallel.sharded",
@@ -80,6 +81,30 @@ class AuditEntry:
     #: knob (the IR202 seeded-violation fixture needs 64-bit types to
     #: exist); engine entries never set it.
     x64: bool = False
+    #: --- hlo-audit tier (analysis/hlo_audit.py) ---
+    #: Positional arg indices of the canonical call that the PRODUCT's
+    #: own jit declares as donated (``donate_argnums``).  The compiled
+    #: artifact must show input/output aliasing for every array leaf
+    #: of these args, or the donation checker fails naming the entry
+    #: and the parameter — a donation silently dropped (refactor, flag,
+    #: wrapper re-jit) is a doubled buffer, not a style issue.
+    #: Donated args must precede any non-array positional arg so the
+    #: flattened parameter numbering is derivable (see
+    #: ``hlo_audit.expected_donated_params``).
+    donate_argnums: tuple = ()
+    #: Optional HLO-tier build override: () -> (lowerable, args,
+    #: kwargs); the tier calls ``lowerable.lower(*args, **kwargs)``.
+    #: Needed when the jaxpr-tier ``build`` wraps the product jit in a
+    #: closure (static args) — re-jitting a closure would silently
+    #: re-add whatever the product jit dropped, so the DONATION check
+    #: must lower through the product's own jitted callable.  Default:
+    #: derived from ``build()``.
+    hlo_build: Callable[[], tuple] | None = None
+    #: Pin the normalized compiled-module text as a golden
+    #: (tests/data/hlo/) and diff against it — reserved for the hot
+    #: kernels whose lowering IS the perf contract; every entry gets
+    #: the per-primitive histogram + memory-ceiling budget regardless.
+    hlo_golden: bool = False
 
 
 class RegistryError(Exception):

@@ -227,3 +227,50 @@ def steady_state_windows_fused(
 
     state = state._replace(acc_ballot=ab, acc_vid=av, learned=lr)
     return state, cnt[:, 0]
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical two-window trace of the fused steady-state kernel
+    (interpret mode, so it traces and compiles on every backend; the
+    IR rules walk the pallas_call's inner jaxpr).  cost=False like the
+    simkern entries: interpret-mode flop counts measure the
+    interpreter, not the kernel.
+
+    The HLO tier lowers through the jitted surface ITSELF
+    (``hlo_build``) — ``donate_argnums=(0,)`` recycles the whole
+    FastState in place, and the donation checker verifies the
+    compiled artifact still carries the input/output aliasing for
+    every state leaf.  A wrapper re-jit here would silently re-add
+    whatever the product jit dropped, which is exactly the regression
+    the checker exists to catch."""
+    from tpu_paxos.analysis.registry import AuditEntry
+
+    reps, quorum = 2, 2
+
+    def build():
+        state = fast.init_state(TILE, 3)
+
+        def fn(state):
+            return steady_state_windows_fused(
+                state, None, reps=reps, quorum=quorum,
+                interpret=True, iota_vids=True,
+            )
+
+        return fn, (state,)
+
+    def hlo_build():
+        state = fast.init_state(TILE, 3)
+        return steady_state_windows_fused, (state, None), dict(
+            reps=reps, quorum=quorum, interpret=True, iota_vids=True,
+        )
+
+    return [AuditEntry(
+        "fastwin.steady_windows", build,
+        covers=("steady_state_windows_fused",),
+        cost=False,
+        donate_argnums=(0,),
+        hlo_build=hlo_build,
+        hlo_golden=True,
+    )]

@@ -2106,6 +2106,27 @@ def audit_entries():
         tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
         return _run_loop_telemetry(cfg, rf), (root, state, tele0)
 
+    def build_gates():
+        # Gate-bearing config: a nonzero vid_cap puts the gate-
+        # membership bitmap and the gated-admission logic in the
+        # traced program (every other sim entry elides it at
+        # vid_cap=0) — the PR-3 follow-on's "remaining gate-bearing
+        # configs".  Gates reference the other proposer's first vid,
+        # so satisfaction crosses proposers in the trace.
+        cfg = audit_canonical_cfg()
+        workload = default_workload(cfg)
+        gates = [
+            np.asarray([int(val.NONE), int(workload[1][0])], np.int32),
+            np.asarray([int(workload[0][0])], np.int32),
+        ]
+        pend, gate, tail, c = prepare_queues(cfg, workload, gates)
+        root = prng.root_key(cfg.seed)
+        state = init_state(cfg, pend, gate, tail, root)
+        rf = build_engine(
+            cfg, c, vid_cap=gates_vid_cap(workload, gates)
+        )
+        return _run_loop(cfg, rf), (root, state)
+
     ir204_why = (
         "conflict-requeue compaction sorts on provably-unique keys "
         "(global instance ids / window offsets); instability cannot "
@@ -2116,20 +2137,24 @@ def audit_entries():
     return [
         AuditEntry(
             "sim.run_rounds", build, covers=("_run_loop",),
-            allow=("IR204",), why=ir204_why,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(
             "sim.run_rounds_episodes", build_episodes,
-            allow=("IR204",), why=ir204_why,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(
             "sim.run_rounds_knobs", build_knobs,
             covers=("_run_loop_knobs",),
-            allow=("IR204",), why=ir204_why,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(
             "sim.run_rounds_telemetry", build_telemetry,
             covers=("_run_loop_telemetry",),
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
+        ),
+        AuditEntry(
+            "sim.run_rounds_gates", build_gates,
             allow=("IR204",), why=ir204_why,
         ),
     ]

@@ -242,7 +242,9 @@ def audit_entries():
 
     return [
         AuditEntry("simkern.store_accepts", build_store,
-                   covers=("store_accepts",), cost=False),
+                   covers=("store_accepts",), cost=False,
+                   hlo_golden=True),
         AuditEntry("simkern.accum_acks", build_ack,
-                   covers=("accum_acks",), cost=False),
+                   covers=("accum_acks",), cost=False,
+                   hlo_golden=True),
     ]

@@ -522,7 +522,7 @@ def audit_entries():
         AuditEntry(
             "fleet.run_lanes", lambda: _build(False),
             covers=("FleetRunner.__init__",),
-            allow=("IR204",), why=ir204_why,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(
             # the telemetry-armed twin: recorder accumulators in the
@@ -530,6 +530,6 @@ def audit_entries():
             # (no host transfers in the loop) is the load-bearing
             # contract here — the ledger must never leave the device
             "fleet.run_lanes_telemetry", lambda: _build(True),
-            allow=("IR204",), why=ir204_why,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
     ]

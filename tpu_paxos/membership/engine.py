@@ -1288,5 +1288,31 @@ def audit_entries():
         fn = _build_round(n, i, c, root, crash_rate=500, comp=None)
         return fn, (state,)
 
-    return [AuditEntry("member.round", build,
-                       covers=("MemberSim.__init__",))]
+    def build_replay():
+        # The replay() configuration (the PR-3 follow-on ROADMAP item
+        # 3 called out as un-audited): replay reconstructs MemberSim
+        # with the RECORDED fault schedule, so the round it steps is
+        # the schedule-bearing build — compiled reach/pause tables as
+        # baked constants (what IR205's const budget watches here),
+        # the heal-horizon clamp, and the paused-receiver drops all in
+        # the traced program.  A regression in this trace is a replay
+        # that diverges from its recording.
+        from tpu_paxos.core import faults as fltm
+
+        n, i = 3, 8
+        c = i * 2 + 8
+        sched = fltm.FaultSchedule((
+            fltm.partition(2, 10, (0,), (1, 2)),
+            fltm.pause(4, 9, 1),
+        ))
+        comp = fltm.compile_schedule(sched, n)
+        root = prng.root_key(0)
+        state = _init(n, i, c)
+        fn = _build_round(n, i, c, root, crash_rate=500, comp=comp)
+        return fn, (state,)
+
+    return [
+        AuditEntry("member.round", build,
+                   covers=("MemberSim.__init__",)),
+        AuditEntry("member.round_replay", build_replay),
+    ]
