@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-slow check lint lint-json audit audit-json bench \
 	bench-sharded parity parity-fast replay-diff replay-diff-member \
-	run stress stress-quick fleet fleet-quick clean
+	run stress stress-quick fleet fleet-quick mc mc-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -42,12 +42,13 @@ audit-json:
 	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --hlo --json
 
 # Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
-# static analyzers first (cheapest signal), then the fast tier with
-# NaN-checking on, then an un-jitted op-by-op smoke of one tiny config
-# per engine (every cond predicate, slice bound, and dtype
-# materializes eagerly).  The pallas interpreter path is part of the
-# fast tier (tests/test_fastwin.py).
-check: lint audit
+# static analyzers first (cheapest signal), then the quick-scope model
+# check (protocol-level gate; the full scope stays out of the fast
+# path — make mc), then the fast tier with NaN-checking on, then an
+# un-jitted op-by-op smoke of one tiny config per engine (every cond
+# predicate, slice bound, and dtype materializes eagerly).  The pallas
+# interpreter path is part of the fast tier (tests/test_fastwin.py).
+check: lint audit mc-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
@@ -110,6 +111,21 @@ fleet:
 fleet-quick:
 	$(PY) -m tpu_paxos fleet --lanes 8 --generations 1 --seed 2 \
 	  --decision-round-max 35 --max-wedges 1 --triage-dir stress-triage
+
+# Exhaustive bounded model checking (tpu_paxos/analysis/modelcheck.py):
+# enumerate EVERY fault scenario of the declared scope — episode kinds
+# x quantized intervals x node groups x rate tiers x knob tiers x
+# gate tiers x seeds, node-permutation symmetry reduced — as chunked
+# device-batched fleet lanes, shrink any counterexample to an
+# mc_scenario_<index> repro artifact, and gate on the pinned scope
+# certificate (analysis/mc_certificate.json).  Re-pin after an
+# intentional scope/engine change: TPU_PAXOS_MC_PIN=1 make mc (and
+# the same for mc-quick).
+mc:
+	$(PY) -m tpu_paxos mc --scope full --triage-dir stress-triage
+
+mc-quick:
+	$(PY) -m tpu_paxos mc --scope quick --triage-dir stress-triage
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

@@ -1,0 +1,487 @@
+"""smallcheck (tpu_paxos/analysis/modelcheck.py): codec bijection,
+symmetry-reduction canonical forms, chunk-boundary coverage, crash
+points, the scope certificate, the seeded-wedge recall pin, and the
+batched-shrinker parity pin.
+
+The codec/symmetry/chunking layers are pure host enumeration and run
+against the COMMITTED scope file, so a scope edit that breaks the
+bijection fails here before it reaches a device.  The dispatch layer
+runs fast-tier on a tiny 3-node scope (one small fleet compile); the
+quick-scope wedge recall and the full-scope certificate smoke are
+slow-tier (they pay real sweeps).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_paxos.analysis import modelcheck as mc
+from tpu_paxos.analysis import triage
+from tpu_paxos.core import faults as flt
+
+
+def _committed_scopes():
+    return mc.load_scopes()
+
+
+TINY = {
+    "n_nodes": 3, "proposers": 2, "horizon": 12, "max_rounds": 400,
+    "intervals": [[2, 8]], "kinds": ["pause", "burst", "crash"],
+    "pause_set_sizes": [1], "burst_rates": [2000],
+    "crash_rounds": [4], "crash_set_sizes": [1], "max_episodes": 2,
+    "knob_tiers": [{"drop_rate": 500, "max_delay": 2}],
+    "gate_tiers": [True, False], "seeds": [0], "chunk_lanes": 4,
+    "n_ids": 2, "n_free": 2,
+}
+
+
+# ---------------- codec ----------------
+
+def test_codec_roundtrip_bijection_committed_scopes():
+    """THE codec contract: index -> scenario -> index is the identity
+    over the ENTIRE cross product of every committed scope (quick is
+    swept exhaustively; full is swept over a stride to stay cheap,
+    plus both boundary indices)."""
+    for name, scope in _committed_scopes().items():
+        enum = mc.ScopeEnum(scope)
+        idxs = (
+            range(enum.total) if enum.total <= 5000
+            else [*range(0, enum.total, 97), 0, enum.total - 1]
+        )
+        for i in idxs:
+            sc = enum.decode(i)
+            assert enum.encode(sc) == i, (name, i)
+        with pytest.raises(IndexError):
+            enum.decode(enum.total)
+        with pytest.raises(IndexError):
+            enum.decode(-1)
+
+
+def test_combo_rank_unrank_inverse_all_sizes():
+    m, k_max = 7, 3
+    n = mc.n_combos(m, k_max)
+    seen = set()
+    for r in range(n):
+        combo = mc.combo_unrank(r, m, k_max)
+        assert len(combo) <= k_max
+        assert list(combo) == sorted(set(combo))
+        assert mc.combo_rank(combo, m, k_max) == r
+        seen.add(combo)
+    assert len(seen) == n  # bijective: no combo repeats
+    with pytest.raises(IndexError):
+        mc.combo_unrank(n, m, k_max)
+    with pytest.raises(ValueError):
+        mc.combo_rank((1, 1), m, k_max)  # not strictly increasing
+
+
+def test_decoded_scenarios_materialize_and_are_distinct():
+    """Every reduced quick-scope scenario materializes a valid
+    (schedule, knobs, seed) triple, and the materialized schedules
+    within one combo-rank block differ only along the declared
+    axes."""
+    scope = _committed_scopes()["quick"]
+    enum = mc.ScopeEnum(scope)
+    for i in enum.reduced[:200]:
+        sc = enum.decode(i)
+        sched = enum.schedule_of(sc)
+        if sched is not None:
+            assert len(sched.episodes) <= scope.max_episodes
+            assert sched.horizon <= scope.horizon
+        enum.faults_of(sc)  # FaultConfig validation runs
+        d = enum.describe(sc)
+        assert d["index"] == i
+
+
+# ---------------- symmetry reduction ----------------
+
+def test_canonical_form_idempotent_and_unique_per_orbit():
+    """canon(canon(x)) == canon(x) for every combo, and each
+    permutation orbit contains exactly one canonical member — the
+    reduction never drops an orbit or keeps two spellings of one."""
+    scope = _committed_scopes()["quick"]
+    enum = mc.ScopeEnum(scope)
+    assert enum._perms, "quick scope should have movable nodes"
+    orbits = {}
+    for cr in range(enum.n_combos):
+        combo = mc.combo_unrank(cr, enum.m, scope.max_episodes)
+        canon = enum.canon_combo(combo)
+        assert enum.canon_combo(canon) == canon  # idempotent
+        orbits.setdefault(canon, set()).add(combo)
+    for canon, members in orbits.items():
+        n_canon = sum(
+            1 for c in members if enum.canon_combo(c) == c
+        )
+        assert n_canon == 1, (canon, members)
+        assert canon in members  # the representative is enumerable
+
+
+def test_reduction_preserves_scenario_blocks():
+    """The reduced index list is exactly the canonical+feasible
+    combos' full per-combo blocks, in increasing order — no scenario
+    of a kept combo is dropped, none of a skipped combo leaks in."""
+    scope = _committed_scopes()["quick"]
+    enum = mc.ScopeEnum(scope)
+    per_combo = enum.n_tiers * enum.n_gates * enum.n_seeds
+    kept = {
+        cr for cr in range(enum.n_combos)
+        if enum.canon_combo(
+            mc.combo_unrank(cr, enum.m, scope.max_episodes)
+        ) == mc.combo_unrank(cr, enum.m, scope.max_episodes)
+        and enum.combo_feasible(
+            mc.combo_unrank(cr, enum.m, scope.max_episodes)
+        )
+    }
+    expect = [
+        i for cr in sorted(kept)
+        for i in range(cr * per_combo, (cr + 1) * per_combo)
+    ]
+    assert enum.reduced == expect
+
+
+def test_crash_minority_cap_filters_combos():
+    """Combos crashing more than a minority are excluded from the
+    dispatch set (no quorum survives; a 'wedge' there is vacuous)."""
+    scope = mc.McScope.from_dict(dict(
+        TINY, crash_rounds=[4, 6], max_episodes=2,
+    ))
+    enum = mc.ScopeEnum(scope)  # 3 nodes -> minority cap is 1
+    over = [
+        combo for cr in range(enum.n_combos)
+        for combo in [mc.combo_unrank(cr, enum.m, scope.max_episodes)]
+        if not enum.combo_feasible(combo)
+    ]
+    assert over, "expected some two-node crash combos"
+    for combo in over:
+        crashed = set()
+        for i in combo:
+            e = enum.alphabet[i]
+            if e.kind == "crash":
+                crashed.update(e.nodes)
+        assert len(crashed) > 1
+        # and none of their scenarios are dispatched
+        cr = mc.combo_rank(combo, enum.m, scope.max_episodes)
+        per = enum.n_tiers * enum.n_gates * enum.n_seeds
+        assert not (set(enum.reduced)
+                    & set(range(cr * per, (cr + 1) * per)))
+
+
+# ---------------- chunking ----------------
+
+def test_chunk_boundary_coverage():
+    """No scenario skipped or duplicated across chunks; only the last
+    chunk pads, by repeating its final lane."""
+    scope = _committed_scopes()["quick"]
+    enum = mc.ScopeEnum(scope)
+    lanes = scope.chunk_lanes
+    chunks = mc.chunk_pad(enum.reduced, lanes)
+    covered = [i for chunk, n_real in chunks for i in chunk[:n_real]]
+    assert covered == enum.reduced  # exact coverage, in order
+    for chunk, n_real in chunks[:-1]:
+        assert n_real == lanes  # only the last chunk may pad
+    last, n_real = chunks[-1]
+    assert len(last) == lanes
+    assert last[n_real:] == [last[n_real - 1]] * (lanes - n_real)
+    assert mc.chunk_pad([], lanes) == []
+    with pytest.raises(ValueError):
+        mc.chunk_pad([1], 0)
+
+
+# ---------------- scope validation ----------------
+
+def test_scope_validation_errors():
+    with pytest.raises(mc.ScopeError, match="unknown scope field"):
+        mc.McScope.from_dict(dict(TINY, bogus=1))
+    with pytest.raises(mc.ScopeError, match="missing field"):
+        mc.McScope.from_dict({"n_nodes": 3})
+    with pytest.raises(mc.ScopeError, match="unknown episode kind"):
+        mc.McScope.from_dict(dict(TINY, kinds=["pause", "meteor"]))
+    with pytest.raises(mc.ScopeError, match="crash_rounds"):
+        mc.McScope.from_dict(dict(TINY, crash_rounds=[]))
+    with pytest.raises(mc.ScopeError, match="interval"):
+        mc.McScope.from_dict(dict(TINY, intervals=[[8, 2]]))
+    with pytest.raises(mc.ScopeError, match="knob tier"):
+        mc.McScope.from_dict(
+            dict(TINY, knob_tiers=[{"drop_rate": 99999}])
+        )
+    with pytest.raises(mc.ScopeError, match="schedule"):
+        mc.McScope.from_dict(
+            dict(TINY, knob_tiers=[{"schedule": None}])
+        )
+
+
+def test_mc_cli_exits_2_on_scope_errors(tmp_path):
+    assert mc.main(["--scope-file", str(tmp_path / "nope.json")]) == 2
+    bad = tmp_path / "scopes.json"
+    bad.write_text("{}")
+    assert mc.main(["--scope-file", str(bad)]) == 2
+    bad.write_text(json.dumps({"quick": dict(TINY, kinds=["meteor"])}))
+    assert mc.main(["--scope-file", str(bad), "--scope", "quick"]) == 2
+
+
+# ---------------- crash points (faults layer) ----------------
+
+def test_crash_episode_tables_and_compiled_rows():
+    e = flt.crash(4, 1)
+    assert (e.t0, e.t1, e.nodes) == (4, 5, (1,))
+    cut, paused, extra, cmask = flt.episode_tables(e, 3)
+    assert not cut.any() and not paused.any() and extra == 0
+    assert cmask.tolist() == [False, True, False]
+    with pytest.raises(ValueError, match="t0 \\+ 1"):
+        flt.Episode("crash", 2, 9, nodes=(1,))
+    with pytest.raises(ValueError, match="at least one node"):
+        flt.Episode("crash", 2, 3)
+    # compiled rows are CUMULATIVE: crashed from t0 through row h
+    sched = flt.FaultSchedule((flt.pause(2, 8, 0), flt.crash(4, 1)))
+    comp = flt.compile_schedule(sched, 3)
+    assert comp.has_crash and comp.horizon == 8
+    assert not comp.crashed[:4].any()
+    assert comp.crashed[4:, 1].all()  # incl. row h: never un-crashes
+    assert not comp.crashed[:, [0, 2]].any()
+
+
+def test_crashes_at_matches_compiled_rows():
+    import jax.numpy as jnp  # noqa: F401  (device mask computation)
+
+    from tpu_paxos.fleet import schedule_table as stm
+
+    sched = flt.FaultSchedule((
+        flt.crash(3, 2), flt.pause(1, 6, 0), flt.crash(7, 0),
+    ))
+    comp = flt.compile_schedule(sched, 4)
+    tab = stm.encode_schedule(sched, 4, max_episodes=4)
+    for t in range(comp.horizon + 3):
+        want = comp.crashed[min(t, comp.horizon)]
+        got = np.asarray(stm.crashes_at(tab, t))
+        assert (got == want).all(), t
+        # the existing three masks stay untouched by crash letters
+        reach, paused, extra = stm.masks_at(tab, t)
+        assert np.asarray(reach).all()
+
+
+def test_membership_engine_rejects_crash_episodes():
+    from tpu_paxos.membership import engine as mem
+
+    with pytest.raises(ValueError, match="crash episodes"):
+        mem.MemberSim(
+            3, n_instances=64,
+            schedule=flt.FaultSchedule((flt.crash(2, 1),)),
+        )
+
+
+# ---------------- dispatch + certificate (tiny scope) ----------------
+
+@pytest.fixture(scope="module")
+def tiny_run():
+    scope = mc.McScope.from_dict(TINY)
+    summary = mc.run_scope(scope, verbose=False)
+    return scope, summary
+
+
+def test_tiny_scope_runs_clean_with_zero_warm_compiles(tiny_run):
+    scope, s = tiny_run
+    enum = mc.ScopeEnum(scope)
+    assert s["ok"] and not s["counterexamples"] and not s["anomalies"]
+    assert s["scenarios_reduced"] == len(enum.reduced)
+    assert len(s["verdict_bits"]) == len(enum.reduced)
+    assert s["verdict_bits"] == "f" * len(enum.reduced)
+    # THE envelope contract: zero XLA compiles after the first chunk
+    assert s["compiles_per_chunk"][0] > 0
+    assert all(c == 0 for c in s["compiles_per_chunk"][1:])
+
+
+def test_certificate_roundtrip_and_drift_naming(tiny_run, tmp_path):
+    scope, s = tiny_run
+    enum = mc.ScopeEnum(scope)
+    cert = mc.make_certificate(s)
+    path = str(tmp_path / "cert.json")
+    mc.save_certificate(path, "tiny", cert)
+    pinned = mc.load_certificates(path)["tiny"]
+    assert mc.check_certificate(pinned, s, enum) == []
+    # a verdict drift names the FIRST diverging scenario's full index
+    drifted = dict(s)
+    bits = list(s["verdict_bits"])
+    bits[3] = "7"  # ok bit cleared at reduced position 3
+    drifted["verdict_bits"] = "".join(bits)
+    fails = mc.check_certificate(pinned, drifted, enum)
+    assert len(fails) == 1
+    assert f"scenario index {enum.reduced[3]}" in fails[0]
+    # a scope edit names the drifted field, not a scenario
+    fails = mc.check_certificate(
+        dict(pinned, scope_sha256="0" * 64), s, enum
+    )
+    assert "scope_sha256" in fails[0]
+    # verdict pins are backend-gated like the flops/HLO pins
+    assert mc.check_certificate(
+        dict(pinned, backend="tpu",
+             verdict_bits="0" * len(s["verdict_bits"])),
+        s, enum,
+    ) == []
+    # chunk-limited runs are never certifiable
+    with pytest.raises(ValueError, match="chunk-limited"):
+        mc.make_certificate(dict(s, chunks_run=s["chunks"] - 1))
+
+
+def test_scope_episode_ceiling_matches_fleet_envelope():
+    """MAX_SCOPE_EPISODES is hardcoded (the scope layer stays
+    jax-free) but must track the fleet's default episode capacity —
+    it is what lets the mc sweep and the shrinker's candidate
+    evaluators share one compiled executable."""
+    from tpu_paxos.fleet import runner as frun
+
+    assert mc.MAX_SCOPE_EPISODES == frun.MAX_EPISODES
+    with pytest.raises(mc.ScopeError, match="max_episodes"):
+        mc.McScope.from_dict(
+            dict(TINY, max_episodes=mc.MAX_SCOPE_EPISODES + 1)
+        )
+
+
+def test_mc_artifacts_live_in_the_triage_namespace():
+    assert "mc_" in triage.DUMP_PREFIXES
+    assert (
+        triage.dump_name("mc", "scenario_42", "json")
+        == "mc_scenario_42.json"
+    )
+
+
+# ---------------- seeded-wedge recall (slow) ----------------
+
+@pytest.mark.slow
+def test_seeded_wedge_found_shrunk_and_replayed(tmp_path, monkeypatch):
+    """THE recall pin: with the PR-1 pause-crash commit-TAKEOVER
+    wedge re-introduced (TPU_PAXOS_SEEDED_WEDGE=takeover), the quick
+    scope's exhaustive enumeration finds a counterexample, shrinks it
+    through the batched triage stack into an ``mc_scenario_<index>``
+    artifact, and the artifact replays byte-identically
+    (decision-log sha256) — and the pinned quick certificate reports
+    the drift by scenario index."""
+    from tpu_paxos.harness import shrink as shr
+
+    monkeypatch.setenv("TPU_PAXOS_SEEDED_WEDGE", "takeover")
+    scopes = _committed_scopes()
+    scope = scopes["quick"]
+    enum = mc.ScopeEnum(scope)
+    s = mc.run_scope(
+        scope, verbose=False, triage_dir=str(tmp_path),
+        max_counterexamples=1,
+    )
+    assert not s["ok"] and s["counterexamples"]
+    assert s["seeded_wedge"] == "takeover"
+    cx = s["counterexamples"][0]
+    idx = cx["scenario"]["index"]
+    # the wedge shape: a deterministic crash point is in the scenario
+    kinds = {e["kind"] for e in cx["scenario"]["episodes"]}
+    assert "crash" in kinds
+    # found exhaustively -> named by its stable full-codec index, and
+    # the artifact carries the deterministic mc_ name
+    art_path = cx["artifact"]
+    assert os.path.basename(art_path) == f"mc_scenario_{idx}.json"
+    assert cx.get("triage_error") is None
+    # byte-identical replay (decision-log sha256), wedge still armed
+    rep = shr.reproduce(art_path)
+    assert rep["match"], rep
+    # the shrunk schedule kept a crash episode (the culprit axis)
+    case, art = shr.load_artifact(art_path)
+    sched = case.cfg.faults.schedule
+    assert sched is not None and any(
+        e.kind == "crash" for e in sched.episodes
+    )
+    # certificate drift: the pinned quick cert (pinned green) must
+    # fail against this run, naming a scenario index
+    pinned = mc.load_certificates().get("quick")
+    assert pinned is not None, "quick certificate must be committed"
+    fails = mc.check_certificate(
+        dict(pinned, verdict_bits=pinned["verdict_bits"][
+            : len(s["verdict_bits"])
+        ]),
+        s, enum,
+    )
+    assert fails and "scenario index" in fails[0]
+
+
+# ---------------- batched shrinker parity (slow) ----------------
+
+@pytest.mark.slow
+def test_batched_shrink_parity_with_sequential(monkeypatch):
+    """The PR-5 follow-on's contract: the batched candidate evaluator
+    is verdict-for-verdict identical to the sequential one, and the
+    whole greedy descent lands on the SAME shrunk case either way."""
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.harness import shrink as shr
+
+    sched = flt.FaultSchedule((
+        flt.partition(5, 35, (0, 1), (2, 3, 4)),
+        flt.pause(10, 20, 3),
+    ))
+    cfg = SimConfig(
+        n_nodes=5, n_instances=64, proposers=(0, 1), seed=7,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=300, dup_rate=500, max_delay=2,
+                           schedule=sched),
+    )
+    wl = [np.arange(100, 110, dtype=np.int32),
+          np.arange(200, 210, dtype=np.int32)]
+    case = shr.ReproCase(
+        cfg=cfg, workload=wl, gates=None,
+        chains=[np.zeros(0, np.int32)] * 2,
+        extra_checks={"decision_round_max": 25},
+    )
+    # evaluator-level parity: one dispatch == N sequential verdicts
+    ev = shr._runtime_candidate_eval(case)
+    batch = shr._runtime_batch_eval(case)
+    assert ev is not None and batch is not None
+    cands = [
+        case.with_schedule(sched.without(0)),
+        case.with_schedule(sched.without(1)),
+        case.with_faults(dataclasses.replace(cfg.faults, drop_rate=0)),
+        dataclasses.replace(
+            case, cfg=dataclasses.replace(cfg, seed=0)
+        ),
+    ]
+    assert batch(cands) == [ev(c) for c in cands]
+    # descent-level parity: identical shrunk case and violation
+    small_b, viol_b = shr.shrink_case(case, batch=True)
+    small_s, viol_s = shr.shrink_case(case, batch=False)
+    assert viol_b == viol_s
+    assert small_b.cfg == small_s.cfg
+    assert [w.tolist() for w in small_b.workload] == [
+        w.tolist() for w in small_s.workload
+    ]
+    # sharded cases cannot ride the runtime engine in either shape
+    assert shr._runtime_batch_eval(
+        dataclasses.replace(case, engine="sharded", devices=2)
+    ) is None
+
+
+# ---------------- full-scope certificate smoke (slow) ----------------
+
+@pytest.mark.slow
+def test_full_scope_counts_and_verdict_prefix_match_certificate():
+    """``make mc`` stays out of tier-1; this smoke pins that the full
+    scope's enumeration matches its committed certificate exactly and
+    that the first chunks' verdict bits reproduce the pinned prefix
+    (same backend)."""
+    import jax
+
+    scope = _committed_scopes()["full"]
+    enum = mc.ScopeEnum(scope)
+    pinned = mc.load_certificates().get("full")
+    assert pinned is not None, "full certificate must be committed"
+    for f in mc._CERT_SHAPE_FIELDS:
+        if f == "scope_sha256":
+            assert pinned[f] == scope.sha256()
+    assert pinned["scenarios_full"] == enum.total
+    assert pinned["scenarios_reduced"] == len(enum.reduced)
+    assert pinned["counterexamples"] == 0
+    s = mc.run_scope(scope, verbose=False, chunk_limit=2)
+    assert s["ok"]
+    fails = mc.check_certificate(
+        dict(pinned, verdict_bits=pinned["verdict_bits"][
+            : len(s["verdict_bits"])
+        ]),
+        s, enum,
+    )
+    if jax.default_backend() == pinned["backend"]:
+        assert fails == [], fails

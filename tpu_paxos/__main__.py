@@ -565,6 +565,13 @@ def main(argv=None) -> int:
         from tpu_paxos.fleet import search as fsearch
 
         return fsearch.main(argv[1:])
+    if argv and argv[0] == "mc":
+        # exhaustive bounded model checking: enumerate a declared
+        # scope's full scenario cross product as chunked fleet lanes,
+        # gate on the pinned scope certificate
+        from tpu_paxos.analysis import modelcheck
+
+        return modelcheck.main(argv[1:])
     if argv and argv[0] == "lint":
         # static analysis: pure-AST, deliberately runs without jax
         from tpu_paxos.analysis import lint as lintm

@@ -24,7 +24,7 @@ ARTIFACT_FORMAT = "tpu-paxos-repro-1"
 
 _SHA256_HEX = frozenset("0123456789abcdef")
 
-EPISODE_KINDS = ("partition", "one_way", "pause", "burst")
+EPISODE_KINDS = ("partition", "one_way", "pause", "burst", "crash")
 
 
 class ArtifactSchemaError(ValueError):

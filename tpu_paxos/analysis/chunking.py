@@ -1,0 +1,30 @@
+"""Fixed-width lane chunking — the mc dispatch path's one shared
+mechanical piece.
+
+The model checker (``analysis/modelcheck.py``) and the greedy
+shrinker's batched candidate evaluator
+(``harness/shrink._runtime_batch_eval``) both dispatch work-lists as
+fleet lanes, and both need every dispatch to carry IDENTICAL lane
+shapes so one executable serves the whole sweep.  This module holds
+the padding rule they share; it is pure stdlib and imports nothing,
+so the shrinker's replay-critical import closure (paxlint's DET
+scope) stays at exactly one extra file.
+"""
+
+from __future__ import annotations
+
+
+def chunk_pad(items: list, lanes: int) -> list[tuple[list, int]]:
+    """Split ``items`` into fixed-width chunks, padding the last by
+    repeating its final item, so EVERY dispatch has identical lane
+    shapes (one executable).  Returns ``[(padded_chunk, n_real),
+    ...]``; padding lanes' results must be ignored."""
+    if lanes < 1:
+        raise ValueError("lanes must be >= 1")
+    out = []
+    for i in range(0, len(items), lanes):
+        chunk = list(items[i:i + lanes])
+        n_real = len(chunk)
+        chunk.extend(chunk[-1:] * (lanes - n_real))
+        out.append((chunk, n_real))
+    return out

@@ -9,7 +9,7 @@ golden diffs (``hlo_audit``).  Two contracts, pinned by test:
   repeated ``make audit`` *overwrites* its own dumps instead of
   accumulating, and a test can assert the exact path.
 - **Retention cap**: the analysis-dump namespace (``jaxpr_*`` /
-  ``hlo_*`` files) is pruned oldest-first past :data:`RETENTION_CAP`
+  ``hlo_*`` / ``mc_*`` files) is pruned oldest-first past :data:`RETENTION_CAP`
   files after every write, so a long-lived checkout's triage dir stays
   bounded even as entries come and go across PRs.  Repro artifacts
   from the stress sweep share the directory but NOT the namespace —
@@ -27,8 +27,12 @@ import re
 RETENTION_CAP = 32
 
 #: Filename prefixes owned by the analysis tiers — the pruning
-#: namespace.  Stress-sweep repro artifacts never match.
-DUMP_PREFIXES = ("jaxpr_", "hlo_")
+#: namespace: jaxpr/HLO breach dumps plus the model checker's
+#: ``mc_scenario_<index>`` counterexample artifacts
+#: (analysis/modelcheck.py), whose deterministic scenario-index names
+#: make repeat runs overwrite.  Stress-sweep repro artifacts
+#: (``repro_*``) never match.
+DUMP_PREFIXES = ("jaxpr_", "hlo_", "mc_")
 
 _SAFE = re.compile(r"[^A-Za-z0-9_]")
 

@@ -18,10 +18,18 @@ windows.  This module adds that layer as a ``FaultSchedule`` of
   ALL of the node's I/O is suppressed while paused, but unlike a
   crash its state is preserved and it resumes at ``t1``;
 - ``burst(t0, t1, drop_rate)`` — loss burst: ``drop_rate``/1e4 is
-  ADDED to the i.i.d. drop rate inside the window (clamped to 1e4).
+  ADDED to the i.i.d. drop rate inside the window (clamped to 1e4);
+- ``crash(t0, *nodes)`` — deterministic fail-stop CRASH POINT: the
+  nodes fail-stop at the end of round ``t0`` (the same
+  takes-effect-next-round timing as the i.i.d. crash injection) and
+  never return — unlike every other kind a crash does not heal, so
+  its interval is the single round ``[t0, t0+1)`` and the liveness
+  contract's crash excusals apply exactly as for sampled crashes.
+  This is the model checker's deterministic crash axis
+  (analysis/modelcheck.py): a (node, round) grid instead of a rate.
 
 Episodes compose: overlapping cuts AND their reachability, pauses OR,
-burst rates add.  ``compile_schedule`` lowers a schedule into dense
+burst rates add, crash sets union (and stay crashed forever).  ``compile_schedule`` lowers a schedule into dense
 per-round tables — ``reach [H+1, N, N]``, ``paused [H+1, N]``,
 ``extra_drop [H+1]`` with row ``H`` (the horizon = last episode end)
 fully healed — which the engines index with ``min(t, H)``; one gather
@@ -49,7 +57,7 @@ from typing import NamedTuple
 
 import numpy as np
 
-KINDS = ("partition", "one_way", "pause", "burst")
+KINDS = ("partition", "one_way", "pause", "burst", "crash")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +101,15 @@ class Episode:
             raise ValueError("pause needs at least one node")
         if self.kind == "burst" and not 0 < self.drop_rate <= 10_000:
             raise ValueError("burst drop_rate must be in (0, 10000]")
+        if self.kind == "crash":
+            if not self.nodes:
+                raise ValueError("crash needs at least one node")
+            if self.t1 != self.t0 + 1:
+                # crashes are permanent — a wider interval would imply
+                # a heal that never happens
+                raise ValueError(
+                    "crash episodes are instants: t1 must be t0 + 1"
+                )
 
     def shifted(self, t0: int, t1: int) -> "Episode":
         """Same episode over a different interval (the shrinker's
@@ -127,6 +144,12 @@ def pause(t0: int, t1: int, *nodes) -> Episode:
 def burst(t0: int, t1: int, drop_rate: int) -> Episode:
     """Loss burst: add drop_rate/1e4 to the i.i.d. drop rate in [t0, t1)."""
     return Episode("burst", t0, t1, drop_rate=drop_rate)
+
+
+def crash(t0: int, *nodes) -> Episode:
+    """Deterministic crash point: ``nodes`` fail-stop at the end of
+    round ``t0`` and never return (module doc)."""
+    return Episode("crash", t0, t0 + 1, nodes=tuple(nodes))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -190,18 +213,23 @@ class FaultSchedule:
 
 class CompiledSchedule(NamedTuple):
     """Dense per-round tables, horizon+1 rows; row ``horizon`` is the
-    healed steady state (engines index with ``min(t, horizon)``).
-    The ``has_*`` flags are compile-time: an engine elides the table
-    gather (and, for ``reach``, the per-edge send masking) entirely
-    when a dimension is absent from the schedule."""
+    healed steady state (engines index with ``min(t, horizon)``) —
+    except ``crashed``, which is CUMULATIVE: crash points never heal,
+    so row ``horizon`` carries every crash and the min-index read
+    stays correct forever.  The ``has_*`` flags are compile-time: an
+    engine elides the table gather (and, for ``reach``, the per-edge
+    send masking) entirely when a dimension is absent from the
+    schedule."""
 
     reach: np.ndarray  # [H+1, N, N] bool, True = src row can reach dst col
     paused: np.ndarray  # [H+1, N] bool
     extra_drop: np.ndarray  # [H+1] int32, additional per-1e4 drop rate
+    crashed: np.ndarray  # [H+1, N] bool, cumulative scheduled crashes
     horizon: int
     has_reach: bool
     has_pause: bool
     has_burst: bool
+    has_crash: bool
 
 
 def validate_episode(e: Episode, n_nodes: int) -> None:
@@ -228,13 +256,16 @@ def validate_episode(e: Episode, n_nodes: int) -> None:
 def episode_tables(e: Episode, n_nodes: int):
     """Static per-episode masks — the single source of truth both
     lowerings share: ``(cut [N, N] bool, paused [N] bool, extra_drop
-    int)`` where ``cut[s, d]`` means the s->d edge is severed while the
-    episode is active.  The diagonal is never cut (a node always
-    reaches itself).  Only the episode's own dimension is non-trivial;
-    the other two return zeros."""
+    int, crash [N] bool)`` where ``cut[s, d]`` means the s->d edge is
+    severed while the episode is active and ``crash`` names the nodes
+    a crash point fail-stops (active from ``t0`` FOREVER — crashes
+    never heal).  The diagonal is never cut (a node always reaches
+    itself).  Only the episode's own dimension is non-trivial; the
+    others return zeros."""
     validate_episode(e, n_nodes)
     cut = np.zeros((n_nodes, n_nodes), bool)
     paused = np.zeros((n_nodes,), bool)
+    crash_m = np.zeros((n_nodes,), bool)
     extra = 0
     if e.kind == "partition":
         group_of = np.full((n_nodes,), len(e.groups), np.int32)
@@ -248,7 +279,9 @@ def episode_tables(e: Episode, n_nodes: int):
         paused[list(e.nodes)] = True
     elif e.kind == "burst":
         extra = e.drop_rate
-    return cut, paused, extra
+    elif e.kind == "crash":
+        crash_m[list(e.nodes)] = True
+    return cut, paused, extra, crash_m
 
 
 def compile_schedule(
@@ -263,19 +296,25 @@ def compile_schedule(
     reach = np.ones((h + 1, n_nodes, n_nodes), bool)
     paused = np.zeros((h + 1, n_nodes), bool)
     extra = np.zeros((h + 1,), np.int64)
+    crashed = np.zeros((h + 1, n_nodes), bool)
     for e in sched.episodes:
         rows = slice(e.t0, e.t1)  # t1 <= h, so row h stays healed
-        cut, pmask, xd = episode_tables(e, n_nodes)
+        cut, pmask, xd, cmask = episode_tables(e, n_nodes)
         reach[rows] &= ~cut[None]
         paused[rows] |= pmask[None]
         extra[rows] += xd
+        # crash points are permanent: from t0 through row h inclusive,
+        # so the engines' min(t, horizon) read never un-crashes a node
+        crashed[e.t0:] |= cmask[None]
     np.einsum("tnn->tn", reach)[:] = True  # a node always reaches itself
     return CompiledSchedule(
         reach=reach,
         paused=paused,
         extra_drop=np.minimum(extra, 10_000).astype(np.int32),
+        crashed=crashed,
         horizon=h,
         has_reach=any(e.kind in ("partition", "one_way") for e in sched.episodes),
         has_pause=any(e.kind == "pause" for e in sched.episodes),
         has_burst=any(e.kind == "burst" for e in sched.episodes),
+        has_crash=any(e.kind == "crash" for e in sched.episodes),
     )

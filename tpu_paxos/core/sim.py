@@ -87,6 +87,7 @@ the watchdog budget is ``max_rounds`` past the final episode end
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import NamedTuple
 
 import jax
@@ -121,6 +122,22 @@ _NEG = jnp.int32(jnp.iinfo(jnp.int32).min)  # -inf sentinel for masked max
 # these through the same path whenever any proposer re-prepares, ref
 # multi/paxos.cpp:1106-1130, 1184-1197).
 IDLE_RESTART_ROUNDS = 8
+
+
+def seeded_wedge() -> str:
+    """Checker-recall knob: ``TPU_PAXOS_SEEDED_WEDGE=takeover``
+    re-introduces the PR-1 pause-crash commit-TAKEOVER wedge (the
+    stall-triggered commit takeover below is compiled OUT, so a
+    committer crashing while a receiver is paused starves the paused
+    node's learner forever — the exact bug the takeover was added to
+    fix).  Read at ENGINE BUILD time: it selects a different traced
+    program, so it is part of the fleet envelope key
+    (fleet/envelope.envelope_key) and artifacts recorded under the
+    flag only replay under the flag.  The model checker's pinned
+    recall test (tests/test_modelcheck.py) arms it to prove the quick
+    scope finds and shrinks the wedge exhaustively; it must never be
+    set in production runs (``mc --pin`` refuses it)."""
+    return os.environ.get("TPU_PAXOS_SEEDED_WEDGE", "")
 
 
 class AcceptorState(NamedTuple):
@@ -461,6 +478,9 @@ def build_engine(
         raise ValueError(f"n_instances {i_cap} not divisible by {n_shards}")
     i_loc = i_cap // n_shards  # instances per shard ([I]-axis array size)
     max_crash = (a - 1) // 2
+    # Seeded-wedge selection happens at BUILD time so the engine's
+    # traced program is fixed per closure (see seeded_wedge()).
+    _wedge_no_takeover = seeded_wedge() == "takeover"
     if runtime_schedule and fc.schedule is not None:
         raise ValueError(
             "runtime_schedule engines take their schedule per call "
@@ -491,6 +511,21 @@ def build_engine(
         jnp.asarray(comp.extra_drop)
         if comp is not None and comp.has_burst
         else None
+    )
+    crash_tab = (
+        jnp.asarray(comp.crashed)
+        if comp is not None and comp.has_crash
+        else None
+    )
+    # Scheduled crash points (or a runtime table that may carry them)
+    # mean `crashed` can change without any i.i.d. draw — the
+    # crash-coupled cached blocks (commit-ack refresh, quiescence
+    # counts) must then refresh every round, exactly like a nonzero
+    # crash rate (exact: the caches are only ever skipped when
+    # provably current).
+    crash_faults = bool(
+        runtime_knobs or fc.crash_rate or runtime_schedule
+        or crash_tab is not None
     )
     from tpu_paxos.core import simkern as _sk
 
@@ -596,14 +631,16 @@ def build_engine(
             # (fleet/schedule_table.masks_at) — same composition
             # semantics as the constant rows below, so the two paths
             # are decision-log-identical for the same schedule.  All
-            # three dimensions are live (the table's content, not its
+            # four dimensions are live (the table's content, not its
             # shape, says which episodes exist).
             reach_t, paused_t, xdrop_t = _stm.masks_at(tab, t)
+            crash_t = _stm.crashes_at(tab, t)  # [A]
         else:
             # Fault-schedule tables for this round (min(t, horizon):
             # row `horizon` is the healed steady state, so
             # post-schedule rounds read all-clear masks at no branch
-            # cost).
+            # cost — crash rows are cumulative, so the same read keeps
+            # scheduled crashes in force forever).
             tt = (
                 jnp.minimum(t, jnp.int32(horizon)) if comp is not None
                 else None
@@ -611,6 +648,7 @@ def build_engine(
             paused_t = pause_tab[tt] if pause_tab is not None else None  # [A]
             reach_t = reach_tab[tt] if reach_tab is not None else None
             xdrop_t = drop_tab[tt] if drop_tab is not None else None  # int32
+            crash_t = crash_tab[tt] if crash_tab is not None else None  # [A]
 
         # I/O-alive mask: crashed OR currently paused nodes neither
         # send, receive, nor act on timers this round.  Excusals
@@ -1075,6 +1113,14 @@ def build_engine(
             newly = (
                 inst_chosen & (commit_vid == val.NONE) & prop_alive[:, None]
             )
+            if _wedge_no_takeover:
+                # seeded-wedge build: a survivor re-accepting an
+                # ALREADY-chosen instance does not re-commit it (the
+                # pre-PR-1-fix behavior the commit takeover exists to
+                # repair) — with the takeover also compiled out, a
+                # committer crashing while a receiver is paused
+                # starves the paused node's learner
+                newly = newly & (mvid == val.NONE)[None]
             commit_vid = jnp.where(newly, cur_batch, commit_vid)
 
             # Decision metrics (the decision log's source of truth).
@@ -1137,12 +1183,12 @@ def build_engine(
             ))  # [P]
             return ca, wait
 
-        if runtime_knobs or fc.crash_rate:
-            # Runtime knobs may carry a nonzero crash rate, so the
-            # cached flag refreshes every round (exact at crash rate 0:
-            # without crashes the excusal never clears without an
-            # arrival, so the cond-gated path below computes the same
-            # values).
+        if crash_faults:
+            # Runtime knobs may carry a nonzero crash rate (and a
+            # schedule may carry crash points), so the cached flag
+            # refreshes every round (exact at crash rate 0: without
+            # crashes the excusal never clears without an arrival, so
+            # the cond-gated path below computes the same values).
             commit_acked, commit_wait = _accum_commit_acks(pr.commit_acked)
         else:
             commit_acked, commit_wait = jax.lax.cond(
@@ -1173,6 +1219,11 @@ def build_engine(
             & (pr.stall >= IDLE_RESTART_ROUNDS)
             & prop_alive
         )
+        if _wedge_no_takeover:
+            # seeded-wedge build (seeded_wedge() == "takeover"): the
+            # takeover never fires — the pre-PR-1-fix engine, compiled
+            # in only for checker-recall pins
+            take_commit = jnp.zeros_like(take_commit)
         any_take = rany(take_commit)
 
         def _takeover(commit_vid, commit_wait):
@@ -1558,6 +1609,15 @@ def build_engine(
 
         # ---------------- crash injection ----------------
         crashed = st.crashed
+        if crash_t is not None:
+            # Scheduled crash points (deterministic fail-stops) apply
+            # before the i.i.d. draw, so the minority-cap `room` below
+            # accounts for them; like the i.i.d. injection they take
+            # effect at the end of the round (first silent round is
+            # t0 + 1).  The schedule author owns the minority cap for
+            # scheduled crashes — the model checker's scope
+            # enumeration never exceeds it.
+            crashed = crashed | crash_t
         if runtime_knobs or fc.crash_rate:
             # Always-on under runtime knobs: the draw consumes only
             # its own stream key, and a zero traced rate makes `want`
@@ -1617,10 +1677,11 @@ def build_engine(
                 jnp.where(met.chosen_vid != val.NONE, idx, -1)
             ))
 
-        if runtime_knobs or fc.crash_rate:
-            # Runtime knobs: measure every round (a runtime crash can
-            # excuse learners without any arrival; exact at rate 0 —
-            # the cache is only ever skipped when provably current).
+        if crash_faults:
+            # Runtime knobs / crash schedules: measure every round (a
+            # crash can excuse learners without any arrival; exact at
+            # rate 0 — the cache is only ever skipped when provably
+            # current).
             sums, hmax = _measure(None)
         else:
             sums, hmax = jax.lax.cond(

@@ -77,7 +77,10 @@ def envelope_key(
     """The hashable envelope of a (cfg, workload-template) pair —
     exactly the static facts the compiled lane program depends on.
     ``telemetry`` is part of the key: arming the flight recorder is a
-    different traced program (the recorder rides the loop carry)."""
+    different traced program (the recorder rides the loop carry).
+    So is the seeded-wedge flag (core/sim.seeded_wedge): an armed
+    build compiles the takeover OUT, and a cache hit across the flag
+    would silently run the wrong engine."""
     wl = [np.asarray(w, np.int32).reshape(-1) for w in workload]
     expected, owner = vdt.expected_owners(cfg, wl)
     gate_sig = (
@@ -86,6 +89,7 @@ def envelope_key(
     )
     return (
         bool(telemetry),
+        simm.seeded_wedge(),
         cfg.n_nodes,
         cfg.proposers,
         cfg.n_instances,

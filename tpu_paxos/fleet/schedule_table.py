@@ -19,6 +19,12 @@ drop masks are computed INSIDE the traced step (:func:`masks_at`):
     paused    =  any_e(active[e] & paused[e])
     extra     =  min(sum_e(active[e] * drop[e]), 10000)
 
+plus the one-sided crash-point mask (:func:`crashes_at` — crash
+episodes are permanent, so their activity test is ``t0[e] <= t`` with
+no upper bound, matching the compiled lowering's cumulative rows):
+
+    crash     =  any_e((t0[e] <= t) & crash[e])
+
 Episode composition therefore matches the compile-time lowering
 exactly — cuts AND their reachability, pauses OR, burst rates add —
 and the parity is pinned per round by tests/test_schedule_table.py
@@ -51,6 +57,9 @@ class ScheduleTable(NamedTuple):
     cut: np.ndarray  # [E, N, N] bool edges severed while active
     paused: np.ndarray  # [E, N] bool nodes paused while active
     extra_drop: np.ndarray  # [E] int32 per-1e4 burst addition
+    crash: np.ndarray  # [E, N] bool crash points (permanent from t0;
+    #     padding slots are all-false, so the t0 <= t read in
+    #     crashes_at stays inert for them)
     horizon: np.ndarray  # [] int32 first round with every episode over
 
 
@@ -74,16 +83,18 @@ def encode_schedule(
     cut = np.zeros((e_cap, n_nodes, n_nodes), bool)
     paused = np.zeros((e_cap, n_nodes), bool)
     extra = np.zeros((e_cap,), np.int32)
+    crash = np.zeros((e_cap, n_nodes), bool)
     for i, e in enumerate(eps):
-        c, p, x = fltm.episode_tables(e, n_nodes)
+        c, p, x, cm = fltm.episode_tables(e, n_nodes)
         t0[i], t1[i] = e.t0, e.t1
-        cut[i], paused[i], extra[i] = c, p, x
+        cut[i], paused[i], extra[i], crash[i] = c, p, x, cm
     return ScheduleTable(
         t0=t0,
         t1=t1,
         cut=cut,
         paused=paused,
         extra_drop=extra,
+        crash=crash,
         horizon=np.int32(sched.horizon if sched is not None else 0),
     )
 
@@ -125,3 +136,16 @@ def masks_at(tab: ScheduleTable, t):
         jnp.int32(10_000),
     )
     return reach, paused, extra
+
+
+def crashes_at(tab: ScheduleTable, t):
+    """Scheduled-crash mask at round ``t``: ``[N] bool``, true from a
+    crash point's ``t0`` FOREVER (crashes never heal, so the activity
+    test is one-sided; padding slots have an all-false crash row and
+    stay inert).  Matches ``faults.compile_schedule``'s cumulative
+    ``crashed`` rows exactly (tests/test_schedule_table.py)."""
+    import jax.numpy as jnp
+
+    t = jnp.asarray(t, jnp.int32)
+    started = tab.t0 <= t  # [E]; one-sided: crash points are permanent
+    return jnp.any(started[:, None] & tab.crash, axis=0)  # [N]

@@ -211,6 +211,62 @@ def _runtime_candidate_eval(case: ReproCase):
     return _eval
 
 
+#: Fixed lane width of the shrinker's batched candidate dispatches:
+#: every batch pads to exactly this many lanes (modelcheck.chunk_pad)
+#: so the whole greedy descent uses ONE lane shape — candidate count
+#: never becomes a compile key.
+SHRINK_BATCH_LANES = 8
+
+
+def _runtime_batch_eval(case: ReproCase):
+    """Multi-lane twin of :func:`_runtime_candidate_eval`: the
+    independent candidates of one greedy pass (all episode drops of
+    the current schedule, both bisection halves, the knob zeroings,
+    the seed pair) become lanes of a single fleet dispatch via the
+    model checker's chunk-padding path (analysis/chunking.chunk_pad
+    — the ROADMAP item-2 follow-on).  Rides the SAME cached envelope
+    runner as the sequential evaluator, so verdicts are pinned equal
+    lane for lane (tests/test_modelcheck.py) and a warmed sweep pays
+    dispatches, not compiles.  Returns ``eval_many(cands) ->
+    [violation-or-None]``, or None for cases that cannot ride the
+    runtime engine (sharded)."""
+    if case.engine != "sim":
+        return None
+    from tpu_paxos.analysis import chunking
+    from tpu_paxos.fleet import envelope as env
+    from tpu_paxos.fleet import runner as frun
+
+    sched = case.cfg.faults.schedule
+    max_eps = max(
+        frun.MAX_EPISODES, 0 if sched is None else len(sched.episodes)
+    )
+    runner = env.runner_for(
+        case.cfg, case.workload, case.gates, max_episodes=max_eps,
+        telemetry=True,
+    )
+
+    def eval_many(cands):
+        out = []
+        for chunk, n_real in chunking.chunk_pad(
+            list(cands), SHRINK_BATCH_LANES
+        ):
+            rep = runner.run(
+                [c.cfg.seed for c in chunk],
+                [c.cfg.faults.schedule for c in chunk],
+                workloads=[(c.workload, c.gates) for c in chunk],
+                knobs=[
+                    dataclasses.replace(c.cfg.faults, schedule=None)
+                    for c in chunk
+                ],
+            )
+            out.extend(
+                _judge(chunk[i], rep.lane_result(i)) for i in range(n_real)
+            )
+        return out
+
+    return eval_many
+
+
 def run_case(case: ReproCase):
     """Execute the case; returns (SimResult, violation-string-or-None)."""
     if case.engine == "sharded":
@@ -258,11 +314,23 @@ class _Budget:
 
 
 def shrink_case(
-    case: ReproCase, max_evals: int = MAX_EVALS, logger=None
+    case: ReproCase, max_evals: int = MAX_EVALS, logger=None,
+    batch: bool = True,
 ) -> tuple[ReproCase, str]:
     """Greedily minimize a failing case (see module doc for the move
     set).  Returns (shrunk case, its violation).  Raises ValueError if
-    the input case does not fail — there is nothing to triage."""
+    the input case does not fail — there is nothing to triage.
+
+    Each pass's independent candidates (every episode drop of the
+    current base, both bisection halves, the knob zeroings, the seed
+    pair) are evaluated in ONE multi-lane fleet dispatch
+    (``_runtime_batch_eval``); the greedy control flow then consumes
+    the batched verdicts exactly as it would sequential ones, so the
+    accepted move sequence — and the final case — is identical to
+    ``batch=False`` (pinned by tests/test_modelcheck.py).  The budget
+    is spent per candidate either way; a batch may evaluate
+    candidates the lazy path would have skipped, which only matters
+    within one dispatch of exhaustion."""
     _, viol = run_case(case)
     if viol is None:
         raise ValueError("case does not fail; nothing to shrink")
@@ -273,36 +341,68 @@ def shrink_case(
     # this envelope); run_case stays the judge of record for the
     # initial failure above and the artifact pin (save_artifact).
     evaluator = _runtime_candidate_eval(case)
+    batch_eval = _runtime_batch_eval(case) if batch else None
 
     def note(msg):
         if logger is not None:
             logger.info("shrink: %s", msg)
 
-    def try_case(cand: ReproCase):
-        if not budget.spend():
-            return None
-        if evaluator is not None:
-            return evaluator(cand)
-        _, v = run_case(cand)
-        return v
+    def try_batch(cands):
+        """Same-base candidates judged together: verdict-for-verdict
+        equal to evaluating each alone (same executable, per-lane
+        decision-log parity).  Candidates past the budget come back
+        None (= not accepted), like the sequential path's refusal."""
+        cands = list(cands)
+        n = min(len(cands), max(budget.left, 0))
+        take = cands[:n]
+        for _ in take:
+            budget.spend()
+        if not take:
+            return [None] * len(cands)
+        if batch_eval is not None and len(take) > 1:
+            vs = batch_eval(take)
+        elif evaluator is not None:
+            vs = [evaluator(c) for c in take]
+        else:
+            vs = [run_case(c)[1] for c in take]
+        return vs + [None] * (len(cands) - n)
 
     changed = True
     while changed and budget.left > 0:
         changed = False
-        # 1. drop episodes, greedily to a fixed point
+        # 1. drop episodes, greedily to a fixed point: all drops of
+        #    the current base ride one dispatch; each acceptance
+        #    changes the base, so the not-yet-visited SUFFIX re-
+        #    batches (indices below i are never re-read — charging
+        #    budget for them would make the batched pass O(E^2)
+        #    evals where the lazy path is O(E))
         sched = case.cfg.faults.schedule
+
+        def _drop_verdicts(s, start):
+            if s is None or start >= len(s.episodes):
+                return []
+            return try_batch(
+                [case.with_schedule(s.without(j))
+                 for j in range(start, len(s.episodes))]
+            )
+
         i = 0
+        base = 0
+        vs = _drop_verdicts(sched, 0)
         while sched is not None and i < len(sched.episodes):
-            v = try_case(case.with_schedule(sched.without(i)))
+            v = vs[i - base]
             if v is not None:
                 ep = sched.episodes[i]
                 note(f"dropped {ep.kind}[{ep.t0},{ep.t1})")
                 case, viol = case.with_schedule(sched.without(i)), v
                 sched = case.cfg.faults.schedule
                 changed = True
+                base = i
+                vs = _drop_verdicts(sched, i)
             else:
                 i += 1
-        # 2. narrow surviving intervals by bisection
+        # 2. narrow surviving intervals by bisection (tail half
+        #    preferred, as in the sequential order)
         sched = case.cfg.faults.schedule
         if sched is not None:
             for i in range(len(sched.episodes)):
@@ -312,15 +412,19 @@ def shrink_case(
                     w = ep.t1 - ep.t0
                     if w <= 1:
                         break
-                    narrowed = None
-                    for t0, t1 in (
+                    halves = (
                         (ep.t0, ep.t0 + w // 2),  # cut the tail half
                         (ep.t1 - w // 2, ep.t1),  # cut the head half
-                    ):
-                        cand = case.with_schedule(
+                    )
+                    cands = [
+                        case.with_schedule(
                             sched.replaced(i, ep.shifted(t0, t1))
                         )
-                        v = try_case(cand)
+                        for t0, t1 in halves
+                    ]
+                    vs = try_batch(cands)
+                    narrowed = None
+                    for (t0, t1), cand, v in zip(halves, cands, vs):
                         if v is not None:
                             narrowed, viol = cand, v
                             note(
@@ -330,32 +434,52 @@ def shrink_case(
                     if narrowed is None:
                         break
                     case, changed = narrowed, True
-        # 3. zero the i.i.d. fault knobs one at a time
-        for repl in (
+        # 3. zero the i.i.d. fault knobs one at a time (an acceptance
+        #    changes the base; the remaining zeroings re-batch)
+        repls = [
             {"drop_rate": 0},
             {"dup_rate": 0},
             {"min_delay": 0, "max_delay": 0},
             {"crash_rate": 0},
-        ):
+        ]
+        while repls and budget.left > 0:
             fc = case.cfg.faults
-            if all(getattr(fc, k) == v for k, v in repl.items()):
-                continue
-            v = try_case(case.with_faults(dataclasses.replace(fc, **repl)))
-            if v is not None:
-                note(f"zeroed {'/'.join(repl)}")
-                case = case.with_faults(dataclasses.replace(fc, **repl))
-                viol, changed = v, True
+            live = [
+                r for r in repls
+                if not all(getattr(fc, k) == v for k, v in r.items())
+            ]
+            if not live:
+                break
+            vs = try_batch(
+                [case.with_faults(dataclasses.replace(fc, **r))
+                 for r in live]
+            )
+            for k, (r, v) in enumerate(zip(live, vs)):
+                if v is not None:
+                    note(f"zeroed {'/'.join(r)}")
+                    case = case.with_faults(
+                        dataclasses.replace(case.cfg.faults, **r)
+                    )
+                    viol, changed = v, True
+                    repls = live[k + 1:]
+                    break
+            else:
+                break
         # 4. seed minimization (bisect toward 0)
         while case.cfg.seed > 0 and budget.left > 0:
-            for cand_seed in (0, case.cfg.seed // 2):
-                if cand_seed == case.cfg.seed:
-                    continue
-                cand = dataclasses.replace(
-                    case, cfg=dataclasses.replace(case.cfg, seed=cand_seed)
+            cand_seeds = [
+                s for s in (0, case.cfg.seed // 2) if s != case.cfg.seed
+            ]
+            cands = [
+                dataclasses.replace(
+                    case, cfg=dataclasses.replace(case.cfg, seed=s)
                 )
-                v = try_case(cand)
+                for s in cand_seeds
+            ]
+            vs = try_batch(cands)
+            for s, cand, v in zip(cand_seeds, cands, vs):
                 if v is not None:
-                    note(f"seed -> {cand_seed}")
+                    note(f"seed -> {s}")
                     case, viol, changed = cand, v, True
                     break
             else:

@@ -144,22 +144,29 @@ N_IDS = 6  # ids per client chain (gated, in-order)
 N_FREE = 8  # ungated values per proposer
 
 
-def _workload(n_prop: int, rng: np.random.Generator):
+def _workload(
+    n_prop: int,
+    rng: np.random.Generator,
+    n_ids: int = N_IDS,
+    n_free: int = N_FREE,
+):
     """Per-proposer workload: one in-order gate chain + free values,
-    with globally unique vids."""
+    with globally unique vids.  ``n_ids``/``n_free`` size the chain
+    and the free set (the model checker's scopes shrink them to keep
+    exhaustive sweeps cheap; the sweep defaults stay canonical)."""
     workload, gates, chains = [], [], []
     nxt = 100
     for p in range(n_prop):
-        chain = np.arange(nxt, nxt + N_IDS, dtype=np.int32)
-        nxt += N_IDS
-        free = np.arange(nxt, nxt + N_FREE, dtype=np.int32)
-        nxt += N_FREE
+        chain = np.arange(nxt, nxt + n_ids, dtype=np.int32)
+        nxt += n_ids
+        free = np.arange(nxt, nxt + n_free, dtype=np.int32)
+        nxt += n_free
         rng.shuffle(free)
         w = np.concatenate([chain, free])
         g = np.concatenate(
             [
                 np.asarray([int(val.NONE)] + chain[:-1].tolist(), np.int32),
-                np.full(N_FREE, int(val.NONE), np.int32),
+                np.full(n_free, int(val.NONE), np.int32),
             ]
         )
         workload.append(w)

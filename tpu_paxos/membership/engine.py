@@ -813,6 +813,17 @@ class MemberSim:
         self.root = prng.root_key(seed)
         self.state = _init(n_nodes, n_instances, self.c)
         self.schedule = schedule  # FaultSchedule | None (core/faults.py)
+        if schedule is not None and any(
+            e.kind == "crash" for e in schedule.episodes
+        ):
+            # deterministic crash points are a general-engine feature;
+            # this engine's crash model is the host-driven i.i.d. one
+            # (its round body never reads the compiled crash rows, so
+            # accepting them would silently ignore the fault)
+            raise ValueError(
+                "membership engine does not support crash episodes; "
+                "use crash_rate"
+            )
         comp = fltm.compile_schedule(schedule, n_nodes)
         self._round = jax.jit(
             _build_round(
