@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-slow check lint lint-json audit audit-json bench \
 	bench-sharded parity parity-fast replay-diff replay-diff-member \
-	run stress stress-quick fleet fleet-quick mc mc-quick clean
+	run stress stress-quick fleet fleet-quick mc mc-quick serve \
+	serve-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -48,7 +49,7 @@ audit-json:
 # un-jitted op-by-op smoke of one tiny config per engine (every cond
 # predicate, slice bound, and dtype materializes eagerly).  The pallas
 # interpreter path is part of the fast tier (tests/test_fastwin.py).
-check: lint audit mc-quick
+check: lint audit mc-quick serve-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
@@ -126,6 +127,25 @@ mc:
 
 mc-quick:
 	$(PY) -m tpu_paxos mc --scope quick --triage-dir stress-triage
+
+# Open-loop serving (tpu_paxos/serve/): Poisson arrivals at an
+# offered rate (values per 1000 rounds) admitted mid-flight through
+# double-buffered dispatch windows; prints the latency-at-load sweep
+# + knee judgment.  RATE=milli / VALUES=n override the sweep shape;
+# add --sequential via SERVE_FLAGS for the naive-dispatch baseline.
+SERVE_RATES ?= 1000,2000,4000,8000,16000,32000
+serve:
+	$(PY) -m tpu_paxos serve --values $(or $(VALUES),512) \
+	  --sweep $(SERVE_RATES) \
+	  --drop-rate 500 --dup-rate 1000 --max-delay 2 $(SERVE_FLAGS)
+
+# Quick pass (wired into make check): a small Poisson run at a
+# sustained rate plus the zero-load limit; exits non-zero if the
+# stream does not drain.
+serve-quick:
+	$(PY) -m tpu_paxos serve --values 64 --rate-milli 4000 \
+	  --drop-rate 500 --dup-rate 1000 --max-delay 2
+	$(PY) -m tpu_paxos serve --values 64 --rate-milli 0
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

@@ -591,6 +591,199 @@ def bench_fleet_record() -> dict:
     return _fleet_record(dts, state_bytes, rounds_min, n_lanes, 1, config)
 
 
+def _serve_record(
+    pipe_walls,
+    seq_walls,
+    state_bytes,
+    rounds_min,
+    n_decided,
+    points,
+    knee,
+    p99_pipe,
+    p99_seq,
+    config,
+):
+    """Record-or-error for a serve timing pair — pure, so
+    tests/test_bench_guards.py drives it with synthetic timings.
+    Roofline floor: every engine round streams the loop state through
+    memory at least once, and both dispatch modes run at least
+    ``rounds_min`` rounds, so ``state_bytes * rounds_min`` bounds the
+    traffic EITHER timing implies; an implausible median on either
+    side withholds the record (raw timings kept) — a roofline-clamped
+    number is never published.  The overlap claim is only meaningful
+    at equal latency, so a p99 mismatch between the modes (the
+    trajectories are bit-identical by construction — a mismatch means
+    the harness broke) also withholds the record."""
+    dt_pipe = sorted(pipe_walls)[len(pipe_walls) // 2]
+    dt_seq = sorted(seq_walls)[len(seq_walls) // 2]
+    raw_p = [round(x, 4) for x in sorted(pipe_walls)]
+    raw_s = [round(x, 4) for x in sorted(seq_walls)]
+    devices = config.get("devices", 1)
+    for label, dt in (("pipelined", dt_pipe), ("sequential", dt_seq)):
+        refusal = _implausible(state_bytes * max(rounds_min, 1), dt, devices)
+        if refusal is not None:
+            return {
+                "engine": "serve",
+                "error": f"{label} timing: {refusal}",
+                "raw_timings_s": raw_p,
+                "sequential_raw_s": raw_s,
+                "config": config,
+            }
+    if p99_pipe != p99_seq:
+        return {
+            "engine": "serve",
+            "error": (
+                f"p99 mismatch between dispatch modes ({p99_pipe} vs "
+                f"{p99_seq}); the modes must run identical "
+                "trajectories — overlap speedup withheld"
+            ),
+            "raw_timings_s": raw_p,
+            "sequential_raw_s": raw_s,
+            "config": config,
+        }
+    return {
+        "engine": "serve",
+        "metric": "serve_sustained_values_per_sec",
+        "value": round(n_decided / dt_pipe, 1),
+        "unit": "values/sec",
+        "raw_timings_s": raw_p,
+        "overlap": {
+            # same offered rate, same seed, bit-identical trajectory:
+            # the speedup is pure dispatch-overhead hiding at exactly
+            # equal p50/p99/p999
+            "sequential_values_per_sec": round(n_decided / dt_seq, 1),
+            "sequential_raw_s": raw_s,
+            "speedup": round(dt_seq / dt_pipe, 2),
+            "p99_rounds": p99_pipe,
+        },
+        "latency_at_load": points,
+        "knee": knee,
+        "config": config,
+    }
+
+
+def bench_serve_record() -> dict:
+    """Secondary record: the OPEN-LOOP SERVING harness
+    (tpu_paxos/serve/) — commit latency (p50/p99/p999 in rounds) at a
+    sustained offered load, a knee-finding sweep bracketing the
+    saturation rate, and the double-buffered dispatch win: the same
+    Poisson stream served with ``windows_per_dispatch`` admission
+    windows amortized per dispatch vs the one-window-per-dispatch
+    sequential baseline.  Every sweep point and both overlap twins
+    run bit-identical virtual trajectories per rate (fixed round
+    windows on the virtual clock), so the latency columns compare at
+    EXACTLY equal p99 and the speedup is pure dispatch-overhead
+    hiding — the serving twin of the fast path's 16-windows-per-call
+    (PERF.md §Headline)."""
+    import numpy as np
+
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.serve import arrivals as arrv
+    from tpu_paxos.serve import driver as sdrv
+    from tpu_paxos.serve import harness as sharness
+    from tpu_paxos.utils import prng
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_values = int(
+        os.environ.get("TPU_PAXOS_BENCH_SERVE_VALUES",
+                       1 << 16 if on_tpu else 1 << 12)
+    )
+    r_window = 2  # serving-grade: admission latency bound = 2 rounds
+    s_dispatch = 32  # amortization depth (the fast path runs 16)
+    rate_milli = 16_000  # 16 values/round: sustained, mid-envelope
+    seed = 0
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=2 * n_values,
+        proposers=(0, 1),
+        seed=seed,
+        max_rounds=20_000,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+    sweep_rates = [2000, 4000, 8000, 16_000, 32_000, 64_000, 128_000,
+                   256_000]
+    vids = np.arange(n_values, dtype=np.int32)
+    rounds = arrv.poisson_rounds(n_values, rate_milli, seed)
+    streams, arrs = arrv.split_round_robin(vids, rounds, 2)
+    # ONE admit width covering the overlap runs AND every sweep rate:
+    # the (S, K) call shape keys the executable, so this is what makes
+    # the whole record one compile per dispatch mode
+    width = arrv.ArrivalPlan(streams, arrs, r_window).max_block
+    for rm in sweep_rates:
+        s_r, a_r = arrv.split_round_robin(
+            vids, arrv.poisson_rounds(n_values, rm, seed), 2
+        )
+        width = max(
+            width, arrv.ArrivalPlan(s_r, a_r, r_window).max_block
+        )
+
+    def one(s, pipelined):
+        return sharness.serve_run(
+            cfg, streams, arrs,
+            rounds_per_window=r_window,
+            windows_per_dispatch=s,
+            admit_width=width,
+            pipelined=pipelined,
+        )
+
+    # warm both executables (one per (S, K) call shape)
+    rep = one(s_dispatch, True)
+    one(1, False)
+    state_bytes = _state_nbytes(
+        sdrv.init_serve_state(
+            cfg, streams, sdrv.vid_bound_of(streams), prng.root_key(seed)
+        )[0]
+    )
+    pipe_walls, seq_walls, rounds_min = [], [], 1 << 30
+    p99_pipe = p99_seq = None
+    for _ in range(5):
+        # interleave the modes so slow phases of the box hit both
+        # timing sets, not just one; median-of-5 (the 2-core dev box
+        # is noisier than the device-tunnel timings the 3-rep records
+        # absorb)
+        rp = one(s_dispatch, True)
+        pipe_walls.append(rp.wall_seconds)
+        rounds_min = min(rounds_min, rp.rounds)
+        p99_pipe = rp.p99
+        rs = one(1, False)
+        seq_walls.append(rs.wall_seconds)
+        rounds_min = min(rounds_min, rs.rounds)
+        p99_seq = rs.p99
+    # latency-at-load sweep + knee: SAME value count and admit width
+    # as the overlap runs, so every rate shares the already-warm
+    # executable (the vid table is a static shape — a smaller sweep
+    # stream would recompile)
+    sweep = sharness.sweep_load(
+        cfg, n_values, sweep_rates,
+        seed=seed,
+        rounds_per_window=r_window,
+        windows_per_dispatch=s_dispatch,
+        admit_width=width,
+    )
+    config = {
+        "n_nodes": cfg.n_nodes,
+        "n_instances": cfg.n_instances,
+        "n_values": n_values,
+        "rate_milli": rate_milli,
+        "rounds_per_window": r_window,
+        "windows_per_dispatch": s_dispatch,
+        "admit_width": width,
+        "faults": "drop500/dup1000/delay0-2",
+        "arrivals": "poisson",
+        "latency_unit": "rounds (virtual clock)",
+        "p50": rep.p50,
+        "p99": rep.p99,
+        "p999": rep.p999,
+        "devices": 1,
+        "platform": jax.devices()[0].platform,
+    }
+    return _serve_record(
+        pipe_walls, seq_walls, state_bytes, rounds_min,
+        rep.decided_values, sweep["points"], sweep["knee"],
+        p99_pipe, p99_seq, config,
+    )
+
+
 def bench_member_record() -> dict:
     """Secondary record: the MEMBERSHIP engine under the BASELINE
     config-5 churn shape at its literal size (grow the acceptor set
@@ -997,6 +1190,11 @@ def main() -> None:
                 secondary.append(bench_fleet_record())
             except Exception as e:
                 secondary.append({"engine": "fleet", "error": str(e)[:500]})
+        if os.environ.get("TPU_PAXOS_BENCH_SERVE", "1") == "1":
+            try:
+                secondary.append(bench_serve_record())
+            except Exception as e:
+                secondary.append({"engine": "serve", "error": str(e)[:500]})
         if os.environ.get("TPU_PAXOS_BENCH_MEMBER", "1") == "1":
             try:
                 secondary.append(bench_member_record())

@@ -118,6 +118,53 @@ def test_fleet_record_withholds_implausible_rate():
     assert rec["raw_timings_s"] == [0.0, 0.0, 0.0]
 
 
+def test_serve_record_publishes_plausible_rate():
+    # ~1 MiB of loop state over >= 100 rounds in ~0.5 s: fine
+    pts = [{"rate_milli": 4000, "p99": 30, "sustained": True}]
+    knee = {"last_sustained_milli": 4000, "first_saturated_milli": None}
+    rec = bench._serve_record(
+        [0.50, 0.52, 0.55], [0.90, 0.95, 1.00], 1 << 20, 100, 4096,
+        pts, knee, 97, 97, {"devices": 1},
+    )
+    assert rec["value"] == pytest.approx(4096 / 0.52, abs=0.1)
+    assert rec["unit"] == "values/sec"
+    assert rec["overlap"]["speedup"] == pytest.approx(0.95 / 0.52, abs=0.01)
+    assert rec["overlap"]["p99_rounds"] == 97
+    assert rec["latency_at_load"] == pts and rec["knee"] == knee
+
+
+def test_serve_record_withholds_implausible_rate():
+    """A lying serve timing (1 GiB of loop state x 1000 rounds in a
+    microsecond) must produce an error record with raw timings and NO
+    value — no roofline-clamped number is ever published, on either
+    dispatch mode's timing set."""
+    for pipe, seq in (
+        ([1e-6, 2e-6, 3e-6], [0.9, 0.95, 1.0]),  # pipelined lies
+        ([0.9, 0.95, 1.0], [1e-6, 2e-6, 3e-6]),  # sequential lies
+    ):
+        rec = bench._serve_record(
+            pipe, seq, 1 << 30, 1000, 4096, [], {}, 97, 97,
+            {"devices": 1},
+        )
+        assert "error" in rec and "roofline" in rec["error"]
+        assert "value" not in rec and "overlap" not in rec
+        assert len(rec["raw_timings_s"]) == 3
+        assert len(rec["sequential_raw_s"]) == 3
+
+
+def test_serve_record_withholds_on_p99_mismatch():
+    """The overlap claim is only meaningful at equal latency; the two
+    modes run bit-identical trajectories by construction, so a p99
+    mismatch means the harness broke — the record is withheld, never
+    published with asterisks."""
+    rec = bench._serve_record(
+        [0.5, 0.52, 0.55], [0.9, 0.95, 1.0], 1 << 20, 100, 4096,
+        [], {}, 97, 115, {"devices": 1},
+    )
+    assert "error" in rec and "p99 mismatch" in rec["error"]
+    assert "value" not in rec
+
+
 def test_guard_headline_publishes_measured_rate():
     # 1 GiB state, 10 ms median: plausible — median rate published
     rate, upper, note = bench._guard_headline(

@@ -73,13 +73,22 @@ def fleet_rep(fleet_fixture):
     return fleet_fixture[1]
 
 
+@pytest.mark.slow
 def test_fleet_parity_all_mixes(fleet_rep):
     """THE fleet contract: >= 8 lanes spanning all four episode-mix
     kinds produce, lane for lane, the same decision-log sha256 as
     single core/sim.run executions of the same (cfg, schedule, seed)
     — one compiled executable vs four schedule-specialized ones.
     (The single-run side compiles once per schedule and reuses the
-    executable across seeds, the stress sweep's pattern.)"""
+    executable across seeds, the stress sweep's pattern.)
+
+    Slow-tier: the single-run side costs four schedule-specialized
+    compiles (~60 s).  Fast-tier coverage of the runtime-vs-static
+    parity contract: tests/test_knobs.py's
+    test_knob_parity_zero_and_debugconf (lane-vs-single-run sha256
+    incl. a partition+pause+burst schedule through the shared
+    envelope) and tests/test_schedule_table.py's per-round mask
+    parity over every episode kind."""
     import jax
 
     from tpu_paxos.utils import prng

@@ -373,7 +373,7 @@ def test_repo_hlo_audit_green():
     )
     golden = [n for n, e in sorted(report["entries"].items())
               if e["golden"] != "-"]
-    assert len(golden) == 9 and all(
+    assert len(golden) == 10 and all(
         report["entries"][n]["golden"] == "ok" for n in golden
     ), {n: report["entries"][n]["golden"] for n in golden}
 

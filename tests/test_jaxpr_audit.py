@@ -300,7 +300,15 @@ def test_save_budget_headroom_and_roundtrip(tmp_path):
     assert jaxpr_audit.load_budget(path) == data
 
 
+@pytest.mark.slow
 def test_budget_breach_dumps_jaxpr_in_process(tmp_path, repo_report):
+    # Slow-tier: re-traces the full 17-entry registry against a tight
+    # budget (~23 s).  Fast-tier coverage: the budget-machinery units
+    # (test_save_budget_caps_with_headroom_and_slack,
+    # test_budget_backend_gate_and_staleness, tests/data fixtures)
+    # plus the repo-green repo_report assertion; the breach -> exit 1
+    # -> named-entry -> triage-dump surface stays pinned end to end
+    # by the slow CLI e2e below.
     tight = {
         "version": 1, "backend": repo_report["backend"],
         "headroom": 0.3, "slack": 8,

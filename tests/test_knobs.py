@@ -212,11 +212,20 @@ def test_runner_knob_validation():
         runner.run([0], [None], workloads=wl1, knobs=[{"drop_rate": 5}])
 
 
+@pytest.mark.slow
 def test_per_lane_vid_sets_are_runtime():
     """Per-lane workloads may change the vid SET and the owner map —
     the verdict's expected/owner tables are runtime inputs now (the
     PR-4 guard is gone); only the envelope's vid bound and table
-    shapes are static."""
+    shapes are static.
+
+    Slow-tier: a 3-lane envelope compile (~30 s).  Fast-tier coverage
+    of runtime per-lane workload/verdict tables: the model checker's
+    tiny-scope e2e (tests/test_modelcheck.py) dispatches per-lane
+    workloads + gate toggles + expected/owner tables through the
+    shared envelope every tier-1 run, and the vid-bound/table-width
+    rejections have their own validation-only cells
+    (tests/test_fleet.py's lane-table guards)."""
     runner = env.runner_for(_cfg(3, dict(max_delay=2)), WL)
     # swap a value between proposers (old guard's "owner" rejection)
     swapped = [w.copy() for w in WL]

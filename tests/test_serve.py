@@ -1,0 +1,388 @@
+"""Open-loop serving harness (tpu_paxos/serve/).
+
+The load-bearing contract is ZERO-LOAD PARITY: a serve run whose
+whole stream arrives at round 0 (offered-load-∞, all admitted in
+window 0) must be decision-log sha256-IDENTICAL to the closed-loop
+engine on the same (cfg, workload) — the serving path (device-side
+admission, donated loop state, fixed-span windows that run past
+quiescence, ingest-stamped telemetry) may not perturb the protocol.
+Alongside: the pipelined and sequential dispatch modes run
+bit-identical trajectories (the bench's "at equal p99" is exact), the
+admission plan admits every value exactly once at the first window
+boundary at or after its arrival, and the ingest-stamped latency
+ledger excludes no-op fills and undecided instances.
+
+All engine-bearing cells share ONE serve-driver compile (module
+geometry below) plus one closed-loop compile — budget ~20 s fast-tier.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.core import values as val
+from tpu_paxos.replay.decision_log import decision_log
+from tpu_paxos.serve import arrivals as arrv
+from tpu_paxos.serve import driver as drv
+from tpu_paxos.serve import harness as sh
+from tpu_paxos.utils import prng
+
+# One geometry for every engine-bearing cell: a single cached window
+# builder (drv.window_for) serves the parity, Poisson, and
+# mode-equality tests; only the S=1-vs-S=2 granularity pin pays a
+# second (S=1) executable of the same program.
+WL = [np.arange(0, 10, dtype=np.int32), np.arange(20, 30, dtype=np.int32)]
+R_WINDOW = 8
+S_DISPATCH = 2  # windows per dispatch for the shared executable
+ADMIT_W = 10  # max stream length: covers the zero-load window-0 block
+
+
+def _cfg(seed=3):
+    return SimConfig(
+        n_nodes=3, n_instances=48, proposers=(0, 1), seed=seed,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+
+
+def _sha(chosen_vid, chosen_ballot):
+    text = decision_log(
+        chosen_vid, chosen_ballot, stride=30, n_instances=len(chosen_vid)
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _serve(cfg, arrs, **kw):
+    kw.setdefault("rounds_per_window", R_WINDOW)
+    kw.setdefault("windows_per_dispatch", S_DISPATCH)
+    kw.setdefault("admit_width", ADMIT_W)
+    return sh.serve_run(cfg, WL, arrs, **kw)
+
+
+# ---------------- arrival processes (pure host) ----------------
+
+
+def test_poisson_rounds_deterministic_and_sorted():
+    a = arrv.poisson_rounds(64, 2000, seed=9)
+    b = arrv.poisson_rounds(64, 2000, seed=9)
+    assert (a == b).all()
+    assert a.dtype == np.int32
+    assert (np.diff(a) >= 0).all()
+    assert (arrv.poisson_rounds(64, 2000, seed=10) != a).any()
+    # rate scales the span: 10x the rate ends ~10x sooner
+    fast = arrv.poisson_rounds(64, 20_000, seed=9)
+    assert fast[-1] < a[-1]
+    with pytest.raises(ValueError, match="immediate_rounds"):
+        arrv.poisson_rounds(8, 0, seed=0)
+
+
+def test_arrivals_imports_jax_free():
+    """The admission planner runs on a serving host's ingestion
+    thread: ``serve.arrivals`` (and the lazy ``tpu_paxos.serve``
+    package import) must not drag in jax.  Subprocess so the
+    already-imported jax of this suite can't mask a regression."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "import tpu_paxos.serve\n"
+         "from tpu_paxos.serve import arrivals\n"
+         "assert 'jax' not in sys.modules, 'jax leaked'\n"
+         "assert arrivals.poisson_rounds(4, 1000, 0).dtype.kind == 'i'\n"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_trace_rounds_validation():
+    assert (arrv.trace_rounds([0, 1, 1, 5]) == [0, 1, 1, 5]).all()
+    with pytest.raises(ValueError, match="nondecreasing"):
+        arrv.trace_rounds([3, 2])
+    with pytest.raises(ValueError, match="nonnegative"):
+        arrv.trace_rounds([-1, 2])
+    assert (arrv.immediate_rounds(3) == 0).all()
+
+
+def test_split_round_robin_preserves_order():
+    vids = np.arange(7, dtype=np.int32)
+    rounds = np.asarray([0, 0, 1, 2, 2, 3, 9], np.int32)
+    streams, arrs = arrv.split_round_robin(vids, rounds, 2)
+    assert [s.tolist() for s in streams] == [[0, 2, 4, 6], [1, 3, 5]]
+    assert [a.tolist() for a in arrs] == [[0, 1, 2, 9], [0, 2, 3]]
+    for a in arrs:
+        assert (np.diff(a) >= 0).all()
+
+
+def test_arrival_plan_admits_each_value_once_at_or_after_arrival():
+    rng = np.random.default_rng(4)
+    for r_win in (1, 4, 8):
+        rounds = np.sort(rng.integers(0, 40, size=23)).astype(np.int32)
+        vids = np.arange(23, dtype=np.int32)
+        streams, arrs = arrv.split_round_robin(vids, rounds, 2)
+        plan = arrv.ArrivalPlan(streams, arrs, r_win)
+        k = plan.max_block
+        seen = {}
+        for j in range(plan.n_windows + 2):  # +2: drain windows empty
+            admit, arr = plan.block(j, k)
+            for pi in range(2):
+                row = admit[pi]
+                got = row[row != int(val.NONE)]
+                # NONE-padded prefix: values only at the front
+                assert (row[len(got):] == int(val.NONE)).all()
+                for o, v in enumerate(got):
+                    assert int(v) not in seen
+                    seen[int(v)] = (j, int(arr[pi, o]))
+        assert len(seen) == 23
+        for v, (j, a_round) in seen.items():
+            # admitted at the first boundary >= arrival, stamped with
+            # the TRUE arrival round
+            assert a_round == int(rounds[v])
+            assert j * r_win >= a_round
+            assert j == 0 or (j - 1) * r_win < a_round
+
+
+def test_arrival_plan_rejects_too_narrow_width():
+    plan = arrv.ArrivalPlan(
+        [np.arange(6, dtype=np.int32)], [np.zeros(6, np.int32)], 4
+    )
+    with pytest.raises(ValueError, match="admit_width"):
+        plan.block(0, plan.max_block - 1)
+
+
+# ---------------- device-side admission + stamping ----------------
+
+
+def test_admit_block_appends_at_tail_and_preserves_padding():
+    cfg = _cfg()
+    pend, gate, tail, c = simm.prepare_queues(cfg, WL)
+    st = simm.init_state(
+        cfg, np.full_like(pend, int(val.NONE)), gate, np.zeros_like(tail),
+        prng.root_key(0),
+    )
+    blk1 = np.asarray(
+        [[0, 1, 2, int(val.NONE)], [20, int(val.NONE)] + [int(val.NONE)] * 2],
+        np.int32,
+    )
+    st = simm.admit_block(st, blk1)
+    assert np.asarray(st.prop.tail).tolist() == [3, 1]
+    blk2 = np.asarray(
+        [[3, int(val.NONE), int(val.NONE), int(val.NONE)],
+         [21, 22, int(val.NONE), int(val.NONE)]], np.int32,
+    )
+    st = simm.admit_block(st, blk2)
+    pend2 = np.asarray(st.prop.pend)
+    assert pend2[0, :4].tolist() == [0, 1, 2, 3]
+    assert pend2[1, :3].tolist() == [20, 21, 22]
+    assert np.asarray(st.prop.tail).tolist() == [4, 3]
+    # everything at and past tail stays NONE (the ring invariant the
+    # engine's window ops and the next admission rely on)
+    assert (pend2[0, 4:] == int(val.NONE)).all()
+    assert (pend2[1, 3:] == int(val.NONE)).all()
+
+
+def test_admit_block_wide_block_near_capacity_never_clamps():
+    """Regression: a bare dynamic_update_slice clamps its start when
+    tail + K passes the row end, silently rewriting LIVE entries
+    below tail with the new block — reachable with a wide admission
+    block (bursty plan: K > assign_window + 8) on a queue near
+    capacity.  admit_block writes through a K-padded row, so only
+    NONE padding ever spills and entries below tail are untouched."""
+    cfg = _cfg()
+    pend, gate, tail, c = simm.prepare_queues(cfg, WL)
+    width = pend.shape[1]
+    k = width  # pathologically wide block: start would clamp to 0
+    pend0 = np.full_like(pend, int(val.NONE))
+    near = width - 3  # tail close to the row end
+    pend0[0, :near] = np.arange(near, dtype=np.int32) + 1000
+    tail0 = np.asarray([near, 0], np.int32)
+    st = simm.init_state(cfg, pend0, gate, tail0, prng.root_key(0))
+    blk = np.full((2, k), int(val.NONE), np.int32)
+    blk[0, 0] = 7  # one real value; the rest is padding
+    st2 = simm.admit_block(st, blk)
+    out = np.asarray(st2.prop.pend)
+    assert (out[0, :near] == pend0[0, :near]).all()  # live entries intact
+    assert out[0, near] == 7
+    assert (out[0, near + 1:] == int(val.NONE)).all()
+    assert np.asarray(st2.prop.tail).tolist() == [near + 1, 0]
+
+
+def test_serve_admit_rounds_filters_noops_and_undecided():
+    import jax.numpy as jnp
+
+    from tpu_paxos.telemetry import recorder as telem
+
+    ingest = jnp.asarray([5, int(val.NONE), 7, 9], jnp.int32)
+    chosen = jnp.asarray(
+        [0, 2, int(val.NONE), int(val.NOOP_BASE) - 3, 3, 99], jnp.int32
+    )
+    adm = np.asarray(telem.serve_admit_rounds(ingest, chosen))
+    #           vid0  vid2  none  noop  vid3  out-of-table
+    assert adm.tolist() == [5, 7, -1, -1, 9, -1]
+
+
+# ---------------- the serving loop (shared driver compile) ----------
+
+
+def test_zero_load_parity_decision_log_sha256():
+    """Acceptance pin: offered-load-∞ (all values admitted in window
+    0) is decision-log sha256-identical to closed-loop ``run()`` —
+    the serving path may not perturb the protocol."""
+    cfg = _cfg()
+    a = simm.run(cfg, WL)
+    rep = _serve(cfg, [np.zeros(len(w), np.int32) for w in WL])
+    assert rep.done and rep.backlog == 0
+    assert _sha(a.chosen_vid, a.chosen_ballot) == _sha(
+        rep.chosen_vid, rep.chosen_ballot
+    )
+    assert (a.chosen_vid == rep.chosen_vid).all()
+    assert (a.chosen_ballot == rep.chosen_ballot).all()
+    # serve windows run fixed spans PAST quiescence; only the round
+    # counter may differ, never the decisions
+    assert rep.rounds >= a.rounds
+
+
+_MID_STREAM_ARRS = [np.sort(a) for a in (
+    np.asarray([0, 2, 3, 9, 9, 11, 17, 20, 21, 33], np.int32),
+    np.asarray([0, 0, 5, 8, 13, 13, 14, 25, 30, 31], np.int32),
+)]  # mid-stream lulls: the engine quiesces between arrivals, so the
+#     stop logic's "done AND every admission seen" guard is exercised
+
+
+def _assert_same_trajectory(a, b):
+    assert (a.chosen_vid == b.chosen_vid).all()
+    assert (a.chosen_ballot == b.chosen_ballot).all()
+    for field in ("p50", "p99", "p999", "latency_max", "decided_values",
+                  "backlog"):
+        assert getattr(a, field) == getattr(b, field), field
+    assert a.summary["latency_hist"] == b.summary["latency_hist"]
+
+
+def test_pipelined_and_sequential_harvest_equal_trajectories():
+    """Host scheduling touches nothing traced: deferred (double-
+    buffered) vs blocking harvest produce the same decisions and the
+    same latency histogram — only wall clock and the pipeline's one
+    extra drain dispatch may differ."""
+    cfg = _cfg()
+    rp = _serve(cfg, _MID_STREAM_ARRS, pipelined=True)
+    rs = _serve(cfg, _MID_STREAM_ARRS, pipelined=False)
+    _assert_same_trajectory(rp, rs)
+    assert rp.done and rs.done and rp.backlog == 0
+
+
+@pytest.mark.slow
+def test_dispatch_granularity_equal_trajectories():
+    """The bench's "at equal p99" is exact: admission happens every
+    rounds_per_window rounds stamped with true arrival rounds
+    regardless of how many windows one dispatch batches — the S=1
+    sequential-dispatch baseline runs the identical trajectory (its
+    own executable, hence slow-tier)."""
+    cfg = _cfg()
+    rp = _serve(cfg, _MID_STREAM_ARRS, pipelined=True)
+    rseq = _serve(cfg, _MID_STREAM_ARRS, windows_per_dispatch=1,
+                  pipelined=False)
+    _assert_same_trajectory(rp, rseq)
+    assert rseq.windows_per_dispatch == 1
+    assert rseq.dispatches > rp.dispatches
+
+
+def test_poisson_open_loop_drains_and_measures_latency():
+    cfg = _cfg()
+    rounds = arrv.poisson_rounds(20, 1500, seed=7)
+    vids = np.concatenate(WL)
+    # keep each proposer's queue order = WL order: split by vid block,
+    # arrival order within block follows the Poisson draw
+    arrs = [np.sort(rounds[0::2]), np.sort(rounds[1::2])]
+    rep = _serve(cfg, arrs)
+    assert rep.done
+    assert rep.decided_values == len(vids)
+    assert rep.backlog == 0
+    assert 0 <= rep.p50 <= rep.p99 <= rep.p999 <= rep.latency_max
+    # the histogram carries exactly the stamped real values
+    assert sum(rep.summary["latency_hist"]) == len(vids)
+    # cumulative decided series is nondecreasing and ends complete
+    assert rep.window_decided == sorted(rep.window_decided)
+    # mid-run quiescence + later admissions: multiple dispatches, and
+    # the final summary is still the full stream's
+    assert rep.dispatches >= 2
+    assert rep.windows == rep.dispatches * S_DISPATCH
+
+
+def test_window_cache_reuses_executable():
+    cfg = _cfg()
+    _, _, _, c = simm.prepare_queues(cfg, WL)
+    vb = drv.vid_bound_of(WL)
+    assert drv.window_for(cfg, c, vb, R_WINDOW) is drv.window_for(
+        cfg, c, vb, R_WINDOW
+    )
+    assert drv.window_for(cfg, c, vb, R_WINDOW + 1) is not drv.window_for(
+        cfg, c, vb, R_WINDOW
+    )
+    # a schedule-bearing cfg must fail LOUDLY even on a warm cache
+    # (the key ignores the schedule; a silent hit would drop the
+    # requested correlated faults)
+    import dataclasses
+
+    from tpu_paxos.core import faults as fltm
+
+    sched_cfg = dataclasses.replace(
+        cfg, faults=dataclasses.replace(
+            cfg.faults,
+            schedule=fltm.FaultSchedule((fltm.pause(1, 3, 0),)),
+        ),
+    )
+    with pytest.raises(ValueError, match="no fault schedule"):
+        drv.window_for(sched_cfg, c, vb, R_WINDOW)
+
+
+# ---------------- knee judgment (pure host) ----------------
+
+
+def test_judge_knee_brackets_saturation():
+    points = [
+        {"rate_milli": 1000, "p50": 10, "sustained": True},
+        {"rate_milli": 2000, "p50": 12, "sustained": True},
+        {"rate_milli": 4000, "p50": 25, "sustained": True},  # p50 blowup
+        {"rate_milli": 8000, "p50": 400, "sustained": False},
+    ]
+    k = sh.judge_knee(points, factor=2.0)
+    assert k["last_sustained_milli"] == 2000
+    assert k["first_saturated_milli"] == 4000
+    # an all-sustained flat sweep never crossed the knee
+    k2 = sh.judge_knee(points[:2], factor=2.0)
+    assert k2["last_sustained_milli"] == 2000
+    assert k2["first_saturated_milli"] is None
+    assert sh.judge_knee([])["first_saturated_milli"] is None
+
+
+def test_serve_point_shape():
+    cfg = _cfg()
+    rep = _serve(cfg, [np.zeros(len(w), np.int32) for w in WL])
+    pt = sh._point(2000, rep)
+    assert pt["sustained"] and pt["decided"] == 20 and pt["backlog"] == 0
+    assert json.dumps(pt)  # JSON-ready
+
+
+# ---------------- CLI (slow: subprocess + its own compile) ----------
+
+
+@pytest.mark.slow
+def test_serve_cli_end_to_end():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_paxos", "serve", "--values", "24",
+         "--rate-milli", "3000", "--nodes", "3", "--backend", "cpu"],
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["metric"] == "serve"
+    assert summary["decided"] == 24 and summary["ok"]
+    assert summary["p50"] <= summary["p99"] <= summary["p999"]

@@ -559,6 +559,13 @@ def main(argv=None) -> int:
         from tpu_paxos.telemetry import export as texport
 
         return texport.main(argv[1:])
+    if argv and argv[0] == "serve":
+        # open-loop serving: Poisson / trace arrivals admitted
+        # mid-flight through double-buffered dispatch windows;
+        # latency-at-load + knee sweep (tpu_paxos/serve/)
+        from tpu_paxos.serve import harness as serve_harness
+
+        return serve_harness.main(argv[1:])
     if argv and argv[0] == "fleet":
         # device-batched schedule search: (seed x schedule) lanes per
         # XLA dispatch, wedges shrunk to repro artifacts

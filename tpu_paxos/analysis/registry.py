@@ -39,6 +39,7 @@ AUDIT_PROVIDERS = (
     "tpu_paxos.parallel.sharded_sim",
     "tpu_paxos.fleet.runner",
     "tpu_paxos.analysis.modelcheck",
+    "tpu_paxos.serve.driver",
 )
 
 

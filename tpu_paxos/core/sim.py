@@ -1805,6 +1805,43 @@ def build_engine(
     return round_fn
 
 
+def admit_block(st: SimState, admit: jax.Array) -> SimState:
+    """Open-loop admission: append one NONE-padded block of fresh vids
+    per proposer at the queue tail (the serve harness's per-window
+    upload; tpu_paxos/serve/driver.py runs this inside the donated
+    dispatch window, between windows of rounds).
+
+    ``admit`` is ``[P, K]`` int32 with each row a value PREFIX padded
+    by ``val.NONE``.  Slots at and past tail are invariantly NONE
+    (nothing ever writes past tail), so the block's padding
+    overwrites NONE with NONE and the ring invariants hold.  The
+    write goes through a K-padded row (the ``_assign`` placement
+    pattern) so the dynamic slice NEVER clamps, for any block width:
+    a bare ``dynamic_update_slice`` would clamp its start when
+    ``tail + K`` passes the row end — rewriting live entries below
+    tail — and wide admission blocks (a bursty arrival plan's
+    ``admit_width`` can exceed ``assign_window``) reach that corner
+    when a queue nears capacity.  Real values never truncate at the
+    pad boundary: ``tail + count <= c`` by the capacity proof in
+    ``prepare_queues`` (total enqueues are bounded by the full
+    planned stream + requeues), so only NONE padding ever spills.
+    Gates are untouched (serve traffic is ungated; gate rows stay
+    all-NONE), and admission happens BETWEEN dispatch windows, so it
+    never races the in-round conflict requeue that also appends at
+    tail."""
+    pr = st.prop
+    k = admit.shape[1]
+    width = pr.pend.shape[-1]
+
+    def _append(row, blk, h):
+        buf = jnp.concatenate([row, jnp.full((k,), val.NONE, jnp.int32)])
+        return jax.lax.dynamic_update_slice(buf, blk, (h,))[:width]
+
+    pend = jax.vmap(_append)(pr.pend, admit, pr.tail)
+    counts = jnp.sum((admit != val.NONE).astype(jnp.int32), axis=1)
+    return st._replace(prop=pr._replace(pend=pend, tail=pr.tail + counts))
+
+
 def default_workload(cfg: SimConfig) -> list[np.ndarray]:
     """``n_instances // 2`` values split round-robin over the
     proposers, leaving instance headroom for no-op fills."""

@@ -44,21 +44,52 @@ import numpy as np
 from tpu_paxos.config import FaultConfig, SimConfig
 from tpu_paxos.core import faults as fltm
 
-KINDS = ("partition", "one_way", "pause", "burst")
+KINDS = ("partition", "one_way", "pause", "burst", "crash")
+
+#: Crash-point grid resolution: crash ``t0`` draws land on this many
+#: quantized slots across the first 3/4 of the horizon (the model
+#: checker's (node, round)-grid discipline, analysis/modelcheck.py —
+#: late crash points mostly land after convergence and waste draws).
+CRASH_GRID = 8
 
 
 def sample_episode(
-    rng: np.random.Generator, n_nodes: int, horizon: int
+    rng: np.random.Generator, n_nodes: int, horizon: int,
+    crashed=frozenset(),
 ) -> fltm.Episode:
     """One grammar draw: a kind, a jittered interval inside
     ``[0, horizon)``, and kind-specific random structure (groups /
-    directions / pause sets / burst rates)."""
+    directions / pause sets / burst rates / crash points).
+
+    ``crashed`` is the set of nodes earlier episodes of the SAME
+    schedule already crash: the deterministic ``crash`` kind (PR 8's
+    fail-stop crash points, never healed) must keep the schedule's
+    TOTAL crashed set a minority — a majority-crash schedule has no
+    quorum, so every lane would red on liveness and the search would
+    drown in false wedges.  A crash draw without minority room falls
+    back to a burst.  (The two branches consume DIFFERENT rng-draw
+    counts; seeded reproducibility still holds because ``room`` is
+    itself a deterministic function of the seeded draw history — the
+    same seed always takes the same branch.  Don't compare draws
+    across different ``crashed`` histories at one seed.)"""
     kind = KINDS[int(rng.integers(len(KINDS)))]
     t0 = int(rng.integers(0, max(1, horizon - 6)))
     width = int(rng.integers(4, max(5, horizon // 2)))
     t1 = min(t0 + width, horizon)
     if t1 <= t0:
         t1 = t0 + 1
+    if kind == "crash":
+        room = (n_nodes - 1) // 2 - len(crashed)
+        avail = np.asarray(
+            [n for n in range(n_nodes) if n not in crashed]
+        )
+        if room >= 1:
+            k = int(rng.integers(1, room + 1))
+            nodes = rng.permutation(avail)[:k]
+            step = max(1, (3 * horizon // 4) // CRASH_GRID)
+            t0c = int(rng.integers(0, CRASH_GRID)) * step
+            return fltm.crash(t0c, *(int(x) for x in nodes))
+        kind = "burst"  # no minority room left in this schedule
     if kind == "partition":
         nodes = rng.permutation(n_nodes)
         k = int(rng.integers(1, n_nodes))  # both sides non-empty
@@ -87,9 +118,13 @@ def sample_schedule(
     horizon: int = 96,
 ) -> fltm.FaultSchedule:
     n_eps = int(rng.integers(1, max_episodes + 1))
-    return fltm.FaultSchedule(tuple(
-        sample_episode(rng, n_nodes, horizon) for _ in range(n_eps)
-    ))
+    eps, crashed = [], set()
+    for _ in range(n_eps):
+        e = sample_episode(rng, n_nodes, horizon, crashed=crashed)
+        if e.kind == "crash":
+            crashed.update(e.nodes)
+        eps.append(e)
+    return fltm.FaultSchedule(tuple(eps))
 
 
 def _generation_margins(rep) -> dict:

@@ -1,0 +1,27 @@
+"""Open-loop serving harness: consensus as a traffic-serving service.
+
+Everything else in the repo benches CLOSED-loop batch — the driver
+owns the value stream and the clock stops at quiescence.  This
+package is the production shape (ROADMAP item 1): values *arrive*
+(Poisson or trace replay at a configured offered rate, in rounds of
+the virtual clock), get admitted into the general engine's contiguous
+free-suffix ring mid-flight, and the metric is commit latency
+(p50/p99/p999) at a sustained offered load, measured on device by the
+flight recorder's latency ledger with admission stamped at INGEST
+time.
+
+Submodules are lazily re-exported (PEP 562), mirroring ``fleet``:
+``driver`` owns the jitted dispatch-window surface (an audit
+provider), ``harness`` the host-side ingestion loop + CLI, and
+``arrivals`` the arrival processes (pure numpy, jax-free).
+"""
+
+_SUBMODULES = ("arrivals", "driver", "harness")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"tpu_paxos.serve.{name}")
+    raise AttributeError(f"module 'tpu_paxos.serve' has no attribute {name!r}")

@@ -1,0 +1,165 @@
+"""Arrival processes and the window-quantized admission plan.
+
+Open-loop load is expressed on the VIRTUAL clock: an arrival process
+assigns each value an arrival round, and the serve harness admits a
+value at the first dispatch-window boundary at or after its arrival
+(window quantization is part of the serving latency — a value
+arriving mid-window waits for the next upload, exactly like a request
+waiting for the next batch in a batched serving system).  Keeping
+load in rounds makes every run deterministic and replayable: the same
+(seed, rate) always produces the same admission timeline, so the
+pipelined and sequential dispatch modes run bit-identical protocol
+trajectories and differ only in wall clock.
+
+Offered load is an integer ``rate_milli`` — values per 1000 rounds —
+so sweep points serialize exactly in JSON and bench records.  The
+offered-load-∞ limit (every value arrives at round 0, the zero-load
+parity shape: the serve path must then be decision-log-identical to
+the closed-loop engine) is :func:`immediate_rounds`.
+
+Pure numpy — this module must import (and stay deterministic) without
+jax, like the rest of the host-side planning layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Local copy of core/values.NONE (-1): importing core.values drags in
+#: jax, and this module's jax-freedom is load-bearing (the admission
+#: plan runs on the ingestion thread of a serving host; tests pin the
+#: import contract).
+NONE = -1
+
+
+def poisson_rounds(n_values: int, rate_milli: int, seed: int) -> np.ndarray:
+    """Sorted int32 arrival rounds of a Poisson process at
+    ``rate_milli`` values per 1000 rounds: exponential inter-arrival
+    gaps with mean ``1000/rate_milli`` rounds, cumulated and floored
+    to the round grid.  Deterministic per (n_values, rate_milli,
+    seed)."""
+    if rate_milli <= 0:
+        raise ValueError(
+            f"rate_milli must be positive (got {rate_milli}); use "
+            "immediate_rounds() for the offered-load-∞ limit"
+        )
+    # domain-separated from every other harness rng (seed tuples mix
+    # like SeedSequence spawn keys)
+    rng = np.random.default_rng((0x53455256, int(seed)))
+    gaps = rng.exponential(1000.0 / rate_milli, size=int(n_values))
+    return np.floor(np.cumsum(gaps)).astype(np.int32)
+
+
+def immediate_rounds(n_values: int) -> np.ndarray:
+    """The offered-load-∞ limit: every value arrives at round 0 (all
+    admitted in window 0 — the zero-load parity shape)."""
+    return np.zeros((int(n_values),), np.int32)
+
+
+def trace_rounds(rounds) -> np.ndarray:
+    """Trace replay: an explicit arrival-round sequence.  Must be
+    nondecreasing and nonnegative (arrival order is admission order —
+    the queue is FIFO per proposer)."""
+    arr = np.asarray(rounds, np.int32).reshape(-1)
+    if arr.size and int(arr.min()) < 0:
+        raise ValueError("trace arrival rounds must be nonnegative")
+    if np.any(np.diff(arr) < 0):
+        raise ValueError("trace arrival rounds must be nondecreasing")
+    return arr
+
+
+def split_round_robin(vids: np.ndarray, rounds: np.ndarray, n_prop: int):
+    """Deal a single (vid, arrival-round) stream round-robin over the
+    proposers in arrival order; per-proposer subsequences stay sorted.
+    Returns ``(streams, arrs)`` — lists of per-proposer arrays."""
+    vids = np.asarray(vids, np.int32).reshape(-1)
+    rounds = np.asarray(rounds, np.int32).reshape(-1)
+    if vids.shape != rounds.shape:
+        raise ValueError("one arrival round per vid required")
+    streams = [vids[p::n_prop] for p in range(n_prop)]
+    arrs = [rounds[p::n_prop] for p in range(n_prop)]
+    return streams, arrs
+
+
+class ArrivalPlan:
+    """The window-quantized admission plan: which values each dispatch
+    window uploads, per proposer.
+
+    Window ``j`` covers rounds ``[j*R, (j+1)*R)`` and its admission
+    happens at round ``j*R``, BEFORE the window's rounds run — so it
+    may admit exactly the values with ``arrival <= j*R`` not yet
+    admitted (a value arriving strictly inside a window waits for the
+    next boundary; one arriving at the boundary makes the upload).
+    Every block is a NONE-padded value prefix per proposer row, ready
+    for :func:`tpu_paxos.core.sim.admit_block`."""
+
+    def __init__(self, streams, arrs, rounds_per_window: int):
+        if len(streams) != len(arrs):
+            raise ValueError("one arrival array per proposer stream")
+        self.streams = [np.asarray(s, np.int32).reshape(-1) for s in streams]
+        self.arrs = [trace_rounds(a) for a in arrs]
+        for s, a in zip(self.streams, self.arrs):
+            if s.shape != a.shape:
+                raise ValueError("one arrival round per stream value")
+        if rounds_per_window <= 0:
+            raise ValueError("rounds_per_window must be positive")
+        self.rounds_per_window = int(rounds_per_window)
+        # cut[p][j]: values of proposer p admitted by the start of
+        # window j (cumulative); the final window admits everything.
+        horizon = max(
+            (int(a[-1]) for a in self.arrs if a.size), default=0
+        )
+        # the last admission window's boundary must reach the latest
+        # arrival: ceil(horizon / R) + 1 windows, indices 0..ceil
+        r = self.rounds_per_window
+        self.n_windows = (horizon + r - 1) // r + 1
+        # _cuts[p][j] .. _cuts[p][j+1]: the stream slice window j
+        # uploads — cumulative arrivals <= j*R, leading 0 so window 0
+        # takes exactly the round-0 arrivals
+        self._cuts = [
+            np.concatenate([
+                [0],
+                np.searchsorted(
+                    a,
+                    np.arange(self.n_windows) * r,
+                    side="right",
+                ),
+            ])
+            for a in self.arrs
+        ]
+
+    @property
+    def n_values(self) -> int:
+        return sum(len(s) for s in self.streams)
+
+    @property
+    def max_block(self) -> int:
+        """Largest per-proposer admission count of any window — the
+        floor for the driver's static ``admit_width``."""
+        widest = 0
+        for cuts in self._cuts:
+            widest = max(widest, int(np.diff(cuts).max(initial=0)))
+        return max(widest, 1)
+
+    def block(self, j: int, admit_width: int):
+        """Window ``j``'s upload: ``(admit [P, K], arr [P, K])`` int32
+        — vids as a NONE-padded prefix per row, their arrival rounds
+        alongside (0 in padding slots; the stamp scatter drops them).
+        Windows past the plan return empty blocks (the drain phase)."""
+        p = len(self.streams)
+        admit = np.full((p, admit_width), NONE, np.int32)
+        arr = np.zeros((p, admit_width), np.int32)
+        if j >= self.n_windows:
+            return admit, arr
+        for pi in range(p):
+            lo, hi = int(self._cuts[pi][j]), int(self._cuts[pi][j + 1])
+            n = hi - lo
+            if n > admit_width:
+                raise ValueError(
+                    f"window {j} admits {n} values for proposer {pi}; "
+                    f"admit_width {admit_width} is too narrow "
+                    "(use >= plan.max_block)"
+                )
+            admit[pi, :n] = self.streams[pi][lo:hi]
+            arr[pi, :n] = self.arrs[pi][lo:hi]
+        return admit, arr

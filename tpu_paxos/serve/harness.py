@@ -1,0 +1,451 @@
+"""Host-side open-loop ingestion loop: double-buffered dispatch
+windows over the serve driver, latency-at-load sweeps, and the
+``python -m tpu_paxos serve`` CLI.
+
+The loop is the serving hot path this package exists for.  Every
+dispatch costs a fixed host+tunnel toll — call dispatch, the
+admission upload, the scalar sync, and the metrics render (~90 ms
+through the TPU device tunnel per PERF.md §Headline; ~2.4 ms of
+call/sync/render overhead even on the CPU dev box) — so the
+**double-buffered path** (the default) batches ``windows_per_
+dispatch`` admission windows into each dispatch: their upload blocks
+travel ahead of the rounds that consume them (the next windows'
+admission overlapped with the current window's compute), the donated
+loop state chains on device, and while one dispatch computes its
+``S x R`` rounds the host assembles the next super-block and renders
+the previous dispatch's metrics.  The **sequential-dispatch
+baseline** (``windows_per_dispatch=1, pipelined=False``) is the
+naive loop: one window per dispatch, block on its outputs, prepare
+the next — paying the per-dispatch toll every window.
+
+Every dispatch granularity runs a BIT-IDENTICAL protocol trajectory:
+windows are fixed round spans, admission happens every
+``rounds_per_window`` rounds stamped with true arrival rounds, and
+the plan is precomputed on the virtual clock (serve/arrivals.py) —
+so the bench's "at equal p99" is exact, not approximate (pinned by
+tests/test_serve.py), and the throughput gap is pure
+dispatch-overhead hiding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.serve import arrivals as arrv
+
+#: Default admission-window span, in rounds.  Small windows are the
+#: serving-grade operating point: admission latency is bounded by one
+#: window span, and the per-dispatch overhead they expose is exactly
+#: what the double buffering hides.
+ROUNDS_PER_WINDOW = 8
+
+#: Default admission windows per dispatch (the double buffer's
+#: amortization depth — the serving twin of the fast path's 16
+#: windows/call).  1 = the sequential-dispatch baseline.
+WINDOWS_PER_DISPATCH = 8
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """One open-loop run's outcome.  ``chosen_vid``/``chosen_ballot``
+    transfer AFTER the clock stops (they exist for decision-log
+    parity checks, not for the serving loop)."""
+
+    cfg: SimConfig
+    n_values: int
+    rounds_per_window: int
+    windows_per_dispatch: int
+    admit_width: int
+    pipelined: bool
+    dispatches: int
+    windows: int
+    rounds: int
+    done: bool
+    decided_values: int  # real stamped values decided (hist mass)
+    backlog: int  # admitted values not yet decided at stop
+    p50: int
+    p99: int
+    p999: int
+    latency_max: int
+    wall_seconds: float
+    summary: dict  # final cumulative flight-recorder summary dict
+    window_decided: list  # per-dispatch cumulative decided counts
+    chosen_vid: np.ndarray
+    chosen_ballot: np.ndarray
+
+    @property
+    def values_per_sec(self) -> float:
+        return self.decided_values / max(self.wall_seconds, 1e-9)
+
+
+def serve_run(
+    cfg: SimConfig,
+    workload,
+    arrival_rounds,
+    *,
+    rounds_per_window: int = ROUNDS_PER_WINDOW,
+    windows_per_dispatch: int = WINDOWS_PER_DISPATCH,
+    admit_width: int | None = None,
+    pipelined: bool = True,
+) -> ServeReport:
+    """Serve one value stream open-loop to completion (or the round
+    budget).  ``workload[p]`` is proposer ``p``'s vid sequence in
+    queue order; ``arrival_rounds[p]`` its per-value arrival rounds
+    (nondecreasing — the queue is FIFO per proposer).  All values
+    arriving at round 0 is the zero-load parity shape: the run is
+    decision-log-identical to closed-loop ``sim.run(cfg, workload)``.
+
+    ``admit_width`` pins the upload block's static width and
+    ``windows_per_dispatch`` the amortization depth (one executable
+    per ``(S, K)`` call shape across a sweep); admission timing —
+    hence the latency distribution — is identical for every ``S``.
+    """
+    import jax.numpy as jnp
+
+    from tpu_paxos.analysis import tracecount
+    from tpu_paxos.core import values as val
+    from tpu_paxos.serve import driver as drv
+    from tpu_paxos.telemetry import recorder as telem
+    from tpu_paxos.utils import prng
+
+    workload = [np.asarray(w, np.int32).reshape(-1) for w in workload]
+    if len(workload) != len(cfg.proposers):
+        raise ValueError("one value stream per proposer required")
+    plan = arrv.ArrivalPlan(workload, arrival_rounds, rounds_per_window)
+    k = int(admit_width or plan.max_block)
+    if plan.max_block > k:
+        raise ValueError(
+            f"admit_width {k} below this plan's max block "
+            f"{plan.max_block}"
+        )
+    s = int(windows_per_dispatch)
+    if s < 1:
+        raise ValueError("windows_per_dispatch must be >= 1")
+    v_bound = drv.vid_bound_of(workload)
+    root = prng.root_key(cfg.seed)
+    ss, c = drv.init_serve_state(cfg, workload, v_bound, root)
+    fn = drv.window_for(cfg, c, v_bound, rounds_per_window)
+    p = len(cfg.proposers)
+    empty = (
+        jnp.full((s, p, k), val.NONE, jnp.int32),
+        jnp.zeros((s, p, k), jnp.int32),
+    )
+    n_disp_admit = (plan.n_windows + s - 1) // s
+    # Watchdog: the budget the closed-loop driver grants, in dispatches.
+    disp_cap = max(
+        cfg.round_budget // (rounds_per_window * s) + 1, n_disp_admit
+    )
+
+    def super_block(d):
+        """Stack dispatch ``d``'s S admission windows; windows past
+        the plan are empty rows (the plan pads them itself)."""
+        a = np.stack([plan.block(d * s + i, k)[0] for i in range(s)])
+        r = np.stack([plan.block(d * s + i, k)[1] for i in range(s)])
+        return jnp.asarray(a), jnp.asarray(r)
+
+    def harvest(out):
+        # the one host sync per dispatch: the stop scalars + the
+        # metrics-plane render of the cumulative summary
+        done, t, summ = out
+        return bool(done), int(t), summ
+
+    window_decided: list[int] = []
+    pending = None
+    last_done, last_t, last_summ = False, 0, None
+    d = harvested = 0
+    t0 = time.perf_counter()  # paxlint: allow[DET001] wall metric only; never reaches artifacts
+    with tracecount.engine_scope("serve"):
+        while True:
+            blk = super_block(d) if d < n_disp_admit else empty
+            ss, done, t, summ = fn(ss, root, *blk)
+            d += 1
+            if pipelined:
+                # double buffer: harvest the PREVIOUS dispatch while
+                # this one computes; its scalars are already (or
+                # nearly) resolved, so the poll costs no device idle
+                if pending is not None:
+                    last_done, last_t, last_summ = harvest(pending)
+                    window_decided.append(int(last_summ.decided))
+                    harvested += 1
+                pending = (done, t, summ)
+            else:
+                # sequential baseline: block on this dispatch before
+                # preparing the next — the bubble the double-buffered
+                # mode exists to hide
+                last_done, last_t, last_summ = harvest((done, t, summ))
+                window_decided.append(int(last_summ.decided))
+                harvested += 1
+            # stop only on a quiescence signal from a dispatch that
+            # saw EVERY admission — a mid-stream lull (quiescent
+            # before later arrivals) must not end the run
+            if harvested >= n_disp_admit and last_done:
+                break
+            if d >= disp_cap:
+                break
+        if pending is not None:
+            last_done, last_t, last_summ = harvest(pending)
+            window_decided.append(int(last_summ.decided))
+    wall = time.perf_counter() - t0  # paxlint: allow[DET001] wall metric only; never reaches artifacts
+
+    # Post-clock rendering: the final cumulative summary + decision
+    # arrays transfer after the serving loop stopped timing.
+    import jax
+
+    host_summ = jax.tree.map(np.asarray, last_summ)
+    sd = telem.summary_to_dict(host_summ)
+    hist = np.asarray(host_summ.lat_hist)
+    lat_max = int(host_summ.lat_max)
+    decided_values = int(hist.sum())
+    return ServeReport(
+        cfg=cfg,
+        n_values=plan.n_values,
+        rounds_per_window=rounds_per_window,
+        windows_per_dispatch=s,
+        admit_width=k,
+        pipelined=pipelined,
+        dispatches=d,
+        windows=d * s,
+        rounds=last_t,
+        done=last_done,
+        decided_values=decided_values,
+        backlog=plan.n_values - decided_values,
+        p50=sd["latency_p50"],
+        p99=sd["latency_p99"],
+        p999=telem.latency_quantile(hist, 0.999, lat_max),
+        latency_max=lat_max,
+        wall_seconds=wall,
+        summary=sd,
+        window_decided=window_decided,
+        chosen_vid=np.asarray(ss.sim.met.chosen_vid),
+        chosen_ballot=np.asarray(ss.sim.met.chosen_ballot),
+    )
+
+
+def _point(rate_milli: int, rep: ServeReport) -> dict:
+    return {
+        "rate_milli": int(rate_milli),
+        "p50": rep.p50,
+        "p99": rep.p99,
+        "p999": rep.p999,
+        "latency_max": rep.latency_max,
+        "decided": rep.decided_values,
+        "backlog": rep.backlog,
+        "done": rep.done,
+        "rounds": rep.rounds,
+        "dispatches": rep.dispatches,
+        "windows": rep.windows,
+        "wall_seconds": round(rep.wall_seconds, 4),
+        "values_per_sec": round(rep.values_per_sec, 1),
+        "sustained": bool(rep.done and rep.backlog == 0),
+    }
+
+
+def judge_knee(points: list, factor: float = 2.0) -> dict:
+    """Bracket the saturation knee from a latency-at-load sweep
+    (points sorted by rate).  A point SATURATES when the run failed
+    to drain inside the round budget, or its MEDIAN commit latency
+    blew past ``factor`` times the lowest-rate median — the classic
+    latency-doubling knee.  The judgment deliberately reads p50, not
+    p99: the tail carries the fault-retry ladder (a dropped accept's
+    ~100-round restart shows up at p99 even at near-zero load), while
+    queueing delay past the engine's service rate moves EVERY value —
+    the median is the saturation signal.  Returns the bracketing
+    rates (None where the sweep never crossed)."""
+    if not points:
+        return {"last_sustained_milli": None, "first_saturated_milli": None}
+    base = max(points[0]["p50"], 1)
+    last_ok, first_bad = None, None
+    for pt in points:
+        # >=: p50 is latency-bucket-quantized, so the doubling point
+        # lands exactly ON factor * base
+        bad = (not pt["sustained"]) or pt["p50"] >= factor * base
+        if bad and first_bad is None:
+            first_bad = pt["rate_milli"]
+        if not bad and first_bad is None:
+            last_ok = pt["rate_milli"]
+    return {
+        "last_sustained_milli": last_ok,
+        "first_saturated_milli": first_bad,
+        "p50_factor": factor,
+        "p50_base": base,
+    }
+
+
+def sweep_load(
+    cfg: SimConfig,
+    n_values: int,
+    rates_milli,
+    *,
+    seed: int = 0,
+    rounds_per_window: int = ROUNDS_PER_WINDOW,
+    windows_per_dispatch: int = WINDOWS_PER_DISPATCH,
+    pipelined: bool = True,
+    knee_factor: float = 2.0,
+    admit_width: int | None = None,
+) -> dict:
+    """Latency at load: one open-loop Poisson run per offered rate
+    (values per 1000 rounds), all sharing ONE compiled window (the
+    admit width is the max over every rate's plan — raise it with
+    ``admit_width`` to share an executable with runs outside the
+    sweep), plus the knee judgment over the resulting points."""
+    vids = np.arange(int(n_values), dtype=np.int32)
+    n_prop = len(cfg.proposers)
+    plans = {}
+    for rm in rates_milli:
+        rounds = arrv.poisson_rounds(n_values, int(rm), seed)
+        plans[int(rm)] = arrv.split_round_robin(vids, rounds, n_prop)
+    width = int(admit_width or 1)
+    for rm, (streams, arrs) in plans.items():
+        width = max(
+            width,
+            arrv.ArrivalPlan(streams, arrs, rounds_per_window).max_block,
+        )
+    points = []
+    for rm in sorted(plans):
+        streams, arrs = plans[rm]
+        rep = serve_run(
+            cfg, streams, arrs,
+            rounds_per_window=rounds_per_window,
+            windows_per_dispatch=windows_per_dispatch,
+            admit_width=width,
+            pipelined=pipelined,
+        )
+        points.append(_point(rm, rep))
+    return {
+        "metric": "serve_latency_at_load",
+        "n_values": int(n_values),
+        "rounds_per_window": int(rounds_per_window),
+        "windows_per_dispatch": int(windows_per_dispatch),
+        "admit_width": int(width),
+        "points": points,
+        "knee": judge_knee(points, knee_factor),
+    }
+
+
+def _serve_cfg(args) -> SimConfig:
+    n_inst = args.instances or max(64, 2 * args.values)
+    return SimConfig(
+        n_nodes=args.nodes,
+        n_instances=n_inst,
+        proposers=tuple(range(args.proposers)),
+        seed=args.seed,
+        max_rounds=args.max_rounds,
+        faults=FaultConfig(
+            drop_rate=args.drop_rate,
+            dup_rate=args.dup_rate,
+            max_delay=args.max_delay,
+            crash_rate=args.crash_rate,
+        ),
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_paxos serve",
+        description="open-loop serving harness: Poisson / trace-replay "
+        "arrivals admitted mid-flight through double-buffered dispatch "
+        "windows; commit latency (p50/p99/p999) at a sustained "
+        "offered load, measured on device",
+    )
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--proposers", type=int, default=2)
+    ap.add_argument("--values", type=int, default=256,
+                    help="values in the arriving stream")
+    ap.add_argument("--rate-milli", type=int, default=2000,
+                    help="offered load: values per 1000 rounds "
+                    "(0 = offered-load-∞, everything arrives at "
+                    "round 0)")
+    ap.add_argument("--sweep", type=str, default="",
+                    help="comma-separated rate_milli list: run the "
+                    "latency-at-load sweep + knee judgment instead "
+                    "of a single rate")
+    ap.add_argument("--trace", type=str, default="",
+                    help="JSON file with an explicit arrival-round "
+                    "list (trace replay; overrides --rate-milli)")
+    ap.add_argument("--rounds-per-window", type=int,
+                    default=ROUNDS_PER_WINDOW)
+    ap.add_argument("--windows-per-dispatch", type=int,
+                    default=WINDOWS_PER_DISPATCH,
+                    help="admission windows batched per dispatch "
+                    "(the double buffer's amortization depth)")
+    ap.add_argument("--sequential", action="store_true",
+                    help="the naive sequential-dispatch baseline: one "
+                    "window per dispatch, block on each before "
+                    "preparing the next")
+    ap.add_argument("--instances", type=int, default=0,
+                    help="instance-space size (0 = 2x values)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-rounds", type=int, default=20_000)
+    ap.add_argument("--drop-rate", type=int, default=0)
+    ap.add_argument("--dup-rate", type=int, default=0)
+    ap.add_argument("--max-delay", type=int, default=0)
+    ap.add_argument("--crash-rate", type=int, default=0)
+    ap.add_argument("--backend", choices=("tpu", "cpu", "auto"),
+                    default="auto")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full JSON summary (default prints "
+                    "a one-line digest)")
+    args = ap.parse_args(argv)
+    from tpu_paxos.__main__ import _select_backend
+
+    _select_backend(args.backend)
+    cfg = _serve_cfg(args)
+    pipelined = not args.sequential
+    s_disp = 1 if args.sequential else args.windows_per_dispatch
+    if args.sweep:
+        rates = [int(x) for x in args.sweep.split(",") if x.strip()]
+        summary = sweep_load(
+            cfg, args.values, rates, seed=args.seed,
+            rounds_per_window=args.rounds_per_window,
+            windows_per_dispatch=s_disp,
+            pipelined=pipelined,
+        )
+        summary["ok"] = bool(
+            summary["points"] and summary["points"][0]["sustained"]
+        )
+    else:
+        vids = np.arange(args.values, dtype=np.int32)
+        if args.trace:
+            with open(args.trace) as f:
+                rounds = arrv.trace_rounds(json.load(f))
+            if len(rounds) != args.values:
+                raise SystemExit(
+                    f"trace has {len(rounds)} arrivals for "
+                    f"--values {args.values}"
+                )
+        elif args.rate_milli <= 0:
+            rounds = arrv.immediate_rounds(args.values)
+        else:
+            rounds = arrv.poisson_rounds(
+                args.values, args.rate_milli, args.seed
+            )
+        streams, arrs = arrv.split_round_robin(
+            vids, rounds, args.proposers
+        )
+        rep = serve_run(
+            cfg, streams, arrs,
+            rounds_per_window=args.rounds_per_window,
+            windows_per_dispatch=s_disp,
+            pipelined=pipelined,
+        )
+        summary = {
+            "metric": "serve",
+            "mode": "pipelined" if pipelined else "sequential",
+            "rate_milli": args.rate_milli,
+            **_point(args.rate_milli, rep),
+            "latency_hist": rep.summary["latency_hist"],
+            "ok": bool(rep.done and rep.backlog == 0),
+        }
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

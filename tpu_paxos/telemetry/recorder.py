@@ -147,6 +147,26 @@ def count_copies(al, dl, mask):
     return offered, dropped, duped, delayed
 
 
+def serve_admit_rounds(ingest, chosen_vid):
+    """Ingest-time admission for the open-loop serving harness
+    (tpu_paxos/serve/): per-instance admission rounds gathered from
+    the harness's per-vid ``ingest`` table (``[V]`` int32, the round
+    each value was uploaded into the queue — stamped at INGEST time,
+    where the closed-loop ledger stamps at first-accept-batch time).
+    Substituted for ``Telemetry.admit_round`` before :func:`summarize`
+    so the same on-device histogram reduction measures arrival-to-
+    commit latency including queueing delay.  No-op hole fills
+    (negative vids) and out-of-table vids reduce to NONE — excluded
+    from the histogram like undecided instances.  On device, inside
+    the serve window jit."""
+    import jax.numpy as jnp
+
+    v = ingest.shape[0]
+    ok = (chosen_vid >= 0) & (chosen_vid < v)
+    adm = ingest[jnp.clip(chosen_vid, 0, v - 1)]
+    return jnp.where(ok, adm, val.NONE)
+
+
 def summarize(tele: Telemetry, final, horizon) -> TelemetrySummary:
     """Reduce one lane's accumulators + final state to the fixed-shape
     summary, on device.  ``final`` is the engine's final ``SimState``;
