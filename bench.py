@@ -602,6 +602,7 @@ def _serve_record(
     p99_pipe,
     p99_seq,
     config,
+    windowed=None,
 ):
     """Record-or-error for a serve timing pair — pure, so
     tests/test_bench_guards.py drives it with synthetic timings.
@@ -647,6 +648,7 @@ def _serve_record(
         "value": round(n_decided / dt_pipe, 1),
         "unit": "values/sec",
         "raw_timings_s": raw_p,
+        **({"windowed": windowed} if windowed is not None else {}),
         "overlap": {
             # same offered rate, same seed, bit-identical trajectory:
             # the speedup is pure dispatch-overhead hiding at exactly
@@ -691,6 +693,12 @@ def bench_serve_record() -> dict:
     r_window = 2  # serving-grade: admission latency bound = 2 rounds
     s_dispatch = 32  # amortization depth (the fast path runs 16)
     rate_milli = 16_000  # 16 values/round: sustained, mid-envelope
+    # Windowed-plane bucket width for the record: 16 buckets x 128
+    # rounds cover the slowest sweep rate's whole run (~2.1k rounds
+    # at 2k milli), so the steady-state median and the SLO burn
+    # windows resolve actual time instead of collapsing into the
+    # overflow bucket.
+    w_rounds = 128
     seed = 0
     cfg = SimConfig(
         n_nodes=5,
@@ -717,30 +725,36 @@ def bench_serve_record() -> dict:
             width, arrv.ArrivalPlan(s_r, a_r, r_window).max_block
         )
 
-    def one(s, pipelined):
+    def one(s, pipelined, window_rounds=w_rounds):
         return sharness.serve_run(
             cfg, streams, arrs,
             rounds_per_window=r_window,
             windows_per_dispatch=s,
             admit_width=width,
             pipelined=pipelined,
+            window_rounds=window_rounds,
         )
 
-    # warm both executables (one per (S, K) call shape)
+    # warm all three executables (one per (S, K) call shape, plus
+    # the window_rounds=0 plain twin); the product path is
+    # windowed-recorder-armed (the serve_run default)
     rep = one(s_dispatch, True)
     one(1, False)
+    rep_plain = one(s_dispatch, True, window_rounds=0)
     state_bytes = _state_nbytes(
         sdrv.init_serve_state(
             cfg, streams, sdrv.vid_bound_of(streams), prng.root_key(seed)
         )[0]
     )
-    pipe_walls, seq_walls, rounds_min = [], [], 1 << 30
+    pipe_walls, seq_walls, plain_walls, rounds_min = [], [], [], 1 << 30
     p99_pipe = p99_seq = None
     for _ in range(5):
-        # interleave the modes so slow phases of the box hit both
-        # timing sets, not just one; median-of-5 (the 2-core dev box
+        # interleave the modes so slow phases of the box hit every
+        # timing set, not just one; median-of-5 (the 2-core dev box
         # is noisier than the device-tunnel timings the 3-rep records
-        # absorb)
+        # absorb).  The window_rounds=0 plain twin rides the same
+        # interleave — its delta vs the armed walls is the windowed
+        # recorder's cost.
         rp = one(s_dispatch, True)
         pipe_walls.append(rp.wall_seconds)
         rounds_min = min(rounds_min, rp.rounds)
@@ -749,16 +763,56 @@ def bench_serve_record() -> dict:
         seq_walls.append(rs.wall_seconds)
         rounds_min = min(rounds_min, rs.rounds)
         p99_seq = rs.p99
+        plain_walls.append(
+            one(s_dispatch, True, window_rounds=0).wall_seconds
+        )
+    # Windowed-recorder overhead, armed vs plain: the SAME stream
+    # through the window_rounds=0 build (the exact pre-windowing
+    # program).  Trajectories are bit-identical (the windowed plane
+    # is read-only), so the values/sec delta is pure recorder cost;
+    # a p99 mismatch means the neutrality contract broke and the
+    # claim is withheld.
+    dt_plain = sorted(plain_walls)[len(plain_walls) // 2]
+    dt_armed = sorted(pipe_walls)[len(pipe_walls) // 2]
+    if rep_plain.p99 != p99_pipe:
+        windowed = {
+            "error": (
+                f"p99 mismatch armed vs plain ({p99_pipe} vs "
+                f"{rep_plain.p99}); the windowed plane must be "
+                "trajectory-neutral — overhead claim withheld"
+            ),
+            "plain_raw_s": [round(x, 4) for x in sorted(plain_walls)],
+        }
+    else:
+        windowed = {
+            "window_rounds": rep.window_rounds,
+            "values_per_sec_armed": round(
+                rep.decided_values / dt_armed, 1
+            ),
+            "values_per_sec_plain": round(
+                rep_plain.decided_values / dt_plain, 1
+            ),
+            "overhead_pct": round(
+                100.0 * (1.0 - dt_plain / max(dt_armed, 1e-9)), 1
+            ),
+            "plain_raw_s": [round(x, 4) for x in sorted(plain_walls)],
+            "p99_rounds": p99_pipe,
+        }
     # latency-at-load sweep + knee: SAME value count and admit width
     # as the overlap runs, so every rate shares the already-warm
     # executable (the vid table is a static shape — a smaller sweep
-    # stream would recompile)
+    # stream would recompile).  The sweep runs the windowed path and
+    # declares a serving SLO, so every point carries its burn-rate
+    # verdict and the record names each rate's breach windows — the
+    # mid-run story the run-total histogram can't tell.
     sweep = sharness.sweep_load(
         cfg, n_values, sweep_rates,
         seed=seed,
         rounds_per_window=r_window,
         windows_per_dispatch=s_dispatch,
         admit_width=width,
+        window_rounds=w_rounds,
+        slo=sharness.ServeSLO(latency_rounds=64, budget_milli=100),
     )
     config = {
         "n_nodes": cfg.n_nodes,
@@ -768,6 +822,7 @@ def bench_serve_record() -> dict:
         "rounds_per_window": r_window,
         "windows_per_dispatch": s_dispatch,
         "admit_width": width,
+        "window_rounds": w_rounds,
         "faults": "drop500/dup1000/delay0-2",
         "arrivals": "poisson",
         "latency_unit": "rounds (virtual clock)",
@@ -777,11 +832,15 @@ def bench_serve_record() -> dict:
         "devices": 1,
         "platform": jax.devices()[0].platform,
     }
-    return _serve_record(
+    record = _serve_record(
         pipe_walls, seq_walls, state_bytes, rounds_min,
         rep.decided_values, sweep["points"], sweep["knee"],
         p99_pipe, p99_seq, config,
+        windowed=windowed,
     )
+    if "slo" in sweep:
+        record["slo"] = sweep["slo"]
+    return record
 
 
 def bench_member_record() -> dict:

@@ -310,7 +310,7 @@ def test_poisson_open_loop_drains_and_measures_latency():
     # mid-run quiescence + later admissions: multiple dispatches, and
     # the final summary is still the full stream's
     assert rep.dispatches >= 2
-    assert rep.windows == rep.dispatches * S_DISPATCH
+    assert rep.windows_count == rep.dispatches * S_DISPATCH
 
 
 def test_window_cache_reuses_executable():
@@ -340,6 +340,71 @@ def test_window_cache_reuses_executable():
         drv.window_for(sched_cfg, c, vb, R_WINDOW)
 
 
+# ---------------- the SLO burn-rate monitor ----------------
+
+
+def test_slo_mid_run_breach_run_total_green():
+    """Acceptance pin: a burst episode under load whose mid-run
+    latency breach the RUN-TOTAL histogram misses — the final
+    distribution meets the declared budget (total_ok), but the
+    windowed burn-rate monitor names the burst's bucket as a breach
+    window.  Same (S, K, R, window_rounds) shapes as every other
+    cell, so this rides the module's one shared executable."""
+    cfg = _cfg()
+    # trickle at one value per 40 rounds, then a 6-value burst at
+    # round 128 (bucket 128 // 32 = 4 of the windowed series)
+    arrs = [
+        np.asarray(sorted([i * 40 for i in range(7)] + [128] * 3),
+                   np.int32)
+        for _ in range(2)
+    ]
+    slo = sh.ServeSLO(latency_rounds=16, budget_milli=400)
+    rep = _serve(cfg, arrs, slo=slo)
+    assert rep.done and rep.backlog == 0
+    assert rep.slo is not None
+    # the run-total verdict is GREEN: overall bad fraction is under
+    # the budget, so a histogram-only judge calls this run healthy
+    assert rep.slo["total_ok"]
+    assert rep.slo["total_bad_milli"] <= slo.budget_milli
+    # ...but the windowed monitor names breach windows, the burst's
+    # bucket among them, with their virtual-round spans
+    assert not rep.slo["ok"]
+    assert 4 in rep.slo["breach_windows"]
+    i4 = rep.slo["breach_windows"].index(4)
+    assert rep.slo["breach_spans"][i4] == [128, 160]
+    assert rep.slo["burn_max"] >= slo.burn_breach
+    # the monitor runs per dispatch: the breach was visible mid-run,
+    # not only in the post-hoc report
+    assert rep.slo_first_breach_dispatch is not None
+    assert rep.slo_first_breach_dispatch <= rep.dispatches
+    # the sweep-point rendering carries the verdict and the windowed
+    # medians the upgraded knee judgment reads
+    pt = sh._point(0, rep)
+    assert pt["slo"]["breach_windows"] == rep.slo["breach_windows"]
+    assert pt["p50_steady"] >= 1
+    assert len(pt["p50_windows"]) == len(rep.windows["decided"])
+
+
+def test_serve_windowed_plane_consistency():
+    """The windowed series is a refinement of the run-total summary
+    (same executable as the parity cells): per-bucket decided counts
+    and latency deltas sum back to the totals.  (Armed-vs-plain
+    trajectory equality for the serve path is pinned by the bench's
+    overhead guard; the single-run twin is pinned fast-tier in
+    test_telemetry.)"""
+    cfg = _cfg()
+    rep = _serve(cfg, _MID_STREAM_ARRS)
+    w = rep.windows
+    assert w is not None and rep.window_rounds == 4 * R_WINDOW
+    assert sum(w["decided"]) == rep.summary["decided"]
+    total = np.asarray(w["lat_hist"]).sum(axis=0)
+    assert total.tolist() == rep.summary["latency_hist"]
+    assert sum(w["dropped"]) == rep.summary["dropped_total"]
+    # every value decided inside the run's round span
+    active = [i for i, n in enumerate(w["decided"]) if n]
+    assert active and active[-1] * rep.window_rounds <= rep.rounds
+
+
 # ---------------- knee judgment (pure host) ----------------
 
 
@@ -358,6 +423,31 @@ def test_judge_knee_brackets_saturation():
     assert k2["last_sustained_milli"] == 2000
     assert k2["first_saturated_milli"] is None
     assert sh.judge_knee([])["first_saturated_milli"] is None
+    assert k["p50_metric"] == "p50"  # no windowed series in sight
+
+
+def test_judge_knee_prefers_windowed_steady_median():
+    """Windowed points are judged on the steady-state median: a run
+    whose warm-up drags the run-total p50 back under the doubling
+    line still saturates when its LAST active window's median has
+    blown out — the run-total column alone would misjudge it."""
+    points = [
+        {"rate_milli": 1000, "p50": 10, "p50_steady": 10,
+         "sustained": True},
+        # run-total 16 < 2x base, but the tail windows sit at 40:
+        # saturation the total hides behind the warm-up
+        {"rate_milli": 2000, "p50": 16, "p50_steady": 40,
+         "sustained": True},
+    ]
+    k = sh.judge_knee(points, factor=2.0)
+    assert k["p50_metric"] == "p50_steady"
+    assert k["last_sustained_milli"] == 1000
+    assert k["first_saturated_milli"] == 2000
+    # without the windowed series the same totals judge sustained
+    bare = [{k2: v for k2, v in pt.items() if k2 != "p50_steady"}
+            for pt in points]
+    kb = sh.judge_knee(bare, factor=2.0)
+    assert kb["first_saturated_milli"] is None
 
 
 def test_serve_point_shape():

@@ -42,6 +42,11 @@ def _case(extra_checks, sched, seed=7, max_rounds=4000):
     )
 
 
+@pytest.mark.slow  # one whole engine compile (~29 s) for a 2-line
+# refusal guard: the green-case run itself (run_case on a clean mix,
+# full suite green) is carried fast-tier by tests/test_stress.py's
+# clean-mix sweep cells and tests/test_sim.py; only the
+# "shrink refuses a non-failing case" ValueError is unique here
 def test_green_case_has_no_violation_and_refuses_shrink():
     case = _case({}, None)
     _, v = shr.run_case(case)

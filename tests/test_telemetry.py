@@ -253,7 +253,10 @@ def test_chrome_trace_structure():
 def test_single_run_recorder_parity():
     """run() vs run_with_telemetry(): identical decision logs and
     result arrays for a schedule + i.i.d.-knob mix on the compile-time
-    path, and the summary's invariants hold against the result."""
+    path — with the WINDOWED plane armed (the default), so this is
+    also the fast-tier windowed-neutrality pin — and the summary's
+    invariants hold against the result, with the windowed series
+    summing back to the cumulative one bucket-for-bucket."""
     cfg = SimConfig(
         n_nodes=3, proposers=(0, 1), n_instances=32, seed=3,
         max_rounds=4000,
@@ -261,7 +264,7 @@ def test_single_run_recorder_parity():
                            crash_rate=1000, schedule=SMALL_SCHED),
     )
     a = simm.run(cfg, WL)
-    b, summ = simm.run_with_telemetry(cfg, WL)
+    b, summ, wsum = simm.run_with_telemetry(cfg, WL)
     assert _log_sha(a) == _log_sha(b)
     assert (np.asarray(a.chosen_vid) == np.asarray(b.chosen_vid)).all()
     assert (np.asarray(a.chosen_round) == np.asarray(b.chosen_round)).all()
@@ -285,6 +288,32 @@ def test_single_run_recorder_parity():
     assert int(summ.lat_max) >= 1
     d = telem.summary_to_dict(summ)
     assert d["latency_p50"] <= d["latency_p99"] <= d["latency_max"]
+    # the windowed series is consistent with the cumulative summary:
+    # per-bucket commit counts, latency deltas, and fault-layer
+    # counters all sum back to the run totals, and stall depth's
+    # bucket max equals the run max
+    assert int(np.asarray(wsum.decided).sum()) == int(summ.decided)
+    assert (
+        np.asarray(wsum.lat_hist).sum(axis=0) == np.asarray(summ.lat_hist)
+    ).all()
+    for f in ("offered", "dropped", "duped", "delayed"):
+        assert int(np.asarray(getattr(wsum, f)).sum()) == int(
+            np.asarray(getattr(summ, f)).sum()
+        ), f
+    assert int(np.asarray(wsum.restarts).sum()) == int(summ.restarts)
+    assert int(np.asarray(wsum.takeovers).sum()) == int(summ.takeovers)
+    assert int(np.asarray(wsum.stall_max).max()) == int(summ.stall_max)
+    # schedule activity is time-localized: this run decides across
+    # more than one bucket (the windowed plane actually resolves time)
+    assert int((np.asarray(wsum.decided) > 0).sum()) >= 2
+    dw = telem.summary_to_dict(summ, wsum)
+    assert dw["windows"]["window_rounds"] == telem.WINDOW_ROUNDS
+    assert sum(dw["windows"]["decided"]) == d["decided"]
+    # (No window_rounds=0 runtime cell here — that build's program
+    # identity with the PR-6 recorder is pinned far more strongly by
+    # the HLO tier: sim.run_rounds_telemetry's golden is
+    # byte-unchanged across the windowing change, re-checked every
+    # `make audit` — and a third engine compile is ~15 s of tier-1.)
 
 
 def test_engine_flag_validation():
@@ -328,7 +357,7 @@ def test_fleet_recorder_parity_grid():
     )
     fc = cfg.faults
     a = simm.run(cfg, WL)
-    b, summ = simm.run_with_telemetry(cfg, WL)
+    b, summ, wsum = simm.run_with_telemetry(cfg, WL)
     r_plain = env.runner_for(cfg, WL)
     r_tel = env.runner_for(cfg, WL, telemetry=True)
     assert r_tel is not r_plain  # the armed twin is its own envelope
@@ -342,9 +371,13 @@ def test_fleet_recorder_parity_grid():
     # lane 1 (different seed) agrees between armed and plain fleets
     assert _log_sha(rp.lane_result(1)) == _log_sha(rt.lane_result(1))
     assert rp.verdict.ok.all() and rt.verdict.ok.all()
-    # the fleet's reduced lane summary IS the single-run summary
+    # the fleet's reduced lane summary IS the single-run summary —
+    # including the [lanes, W] windowed series (same bucket width)
     assert rp.lane_telemetry(0) is None
-    assert rt.lane_telemetry(0) == telem.summary_to_dict(summ)
+    assert rp.windows is None and rt.windows is not None
+    assert rt.lane_telemetry(0) == telem.summary_to_dict(
+        summ, wsum, telem.WINDOW_ROUNDS
+    )
 
 
 @pytest.mark.slow

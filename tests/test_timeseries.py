@@ -1,0 +1,299 @@
+"""The windowed time-series plane (telemetry/recorder windows): the
+host-side reducers on crafted ``[lanes, W]`` stacks, the on-device
+window reduction's edge cases (runs shorter than one bucket, rounds
+landing exactly on a bucket boundary, overflow clamping), the SLO
+burn-rate arithmetic, and the windowed Perfetto counter tracks.
+
+Engine-level neutrality and the serve-side breach pins live with
+their subsystems (tests/test_telemetry.py, tests/test_serve.py);
+everything here is host arithmetic plus tiny eager jnp ops — no
+engine compiles.
+"""
+
+import types
+
+import numpy as np
+
+from tpu_paxos.serve import harness as sh
+from tpu_paxos.telemetry import export as texport
+from tpu_paxos.telemetry import recorder as telem
+
+W = telem.NUM_WINDOWS
+B = telem.NUM_LAT_BUCKETS
+
+
+def _mk_windows(**over):
+    """A host-numpy WindowSummary with recognizable values."""
+    lat = np.zeros((W, B), np.int32)
+    lat[0, 1] = 4  # bucket (1, 2]
+    lat[2, 4] = 6  # bucket (8, 16]
+    base = dict(
+        offered=np.asarray([100] + [10] * (W - 1), np.int32),
+        dropped=np.asarray([10] + [1] * (W - 1), np.int32),
+        duped=np.full(W, 2, np.int32),
+        delayed=np.full(W, 3, np.int32),
+        stall_max=np.asarray([0, 5] + [1] * (W - 2), np.int32),
+        takeovers=np.asarray([0, 1] + [0] * (W - 2), np.int32),
+        restarts=np.asarray([2] + [0] * (W - 1), np.int32),
+        decided=lat.sum(axis=1).astype(np.int32),
+        lat_hist=lat,
+    )
+    base.update(over)
+    return telem.WindowSummary(**base)
+
+
+# ---------------- host-side reducers ----------------
+
+
+def test_windows_to_dict():
+    d = telem.windows_to_dict(_mk_windows(), 16, lat_max=14)
+    assert d["window_rounds"] == 16 and d["n_windows"] == W
+    assert d["decided"][0] == 4 and d["decided"][2] == 6
+    assert sum(d["decided"]) == 10
+    assert d["offered"][0] == 100 and d["dropped"][0] == 10
+    assert d["drop_rate_observed"][0] == 1000.0
+    assert d["stall_max"][1] == 5 and d["takeovers"][1] == 1
+    # per-bucket quantiles: bucket edges clamped to the run max;
+    # empty buckets report -1
+    assert d["latency_p50"][0] == 2 and d["latency_p99"][0] == 2
+    assert d["latency_p50"][2] == 14  # edge 16 clamped to lat_max 14
+    assert d["latency_p50"][1] == -1 and d["latency_p99"][1] == -1
+    assert d["lat_hist"][2][4] == 6
+    assert d["latency_edges"] == list(telem.LAT_EDGES)
+
+
+def test_reduce_lanes_windows_on_crafted_stack():
+    """[lanes, W] reduction: counts sum, stall depth maxes, and the
+    quantiles walk the lane-summed per-bucket histograms."""
+    import jax
+
+    lane2_lat = np.zeros((W, B), np.int32)
+    lane2_lat[2, 6] = 2  # bucket (32, 64] — stretches bucket 2's p99
+    lanes = jax.tree.map(
+        lambda *xs: np.stack(xs),
+        _mk_windows(),
+        _mk_windows(
+            stall_max=np.asarray([7] + [0] * (W - 1), np.int32),
+            lat_hist=lane2_lat,
+            decided=lane2_lat.sum(axis=1).astype(np.int32),
+        ),
+    )
+    d = telem.reduce_lanes_windows(lanes, 16, lat_max=40)
+    assert d["decided"][0] == 4 and d["decided"][2] == 8
+    assert d["offered"][0] == 200
+    assert d["stall_max"][0] == 7 and d["stall_max"][1] == 5
+    assert d["latency_p50"][2] == 16  # 6 of 8 at (8, 16]
+    assert d["latency_p99"][2] == 40  # lane 2's (32, 64] tail, clamped
+    # the margin series is min over lanes of (patience - stall):
+    # bucket 0 is lane 2's 7-deep stall, bucket 1 lane 1's 5-deep
+    m = telem.stall_margin_series(lanes, patience=8)
+    assert m[0] == 1 and m[1] == 3 and m[2] == 7
+    # single-lane form: no lane axis
+    assert telem.stall_margin_series(_mk_windows(), 8)[1] == 3
+
+
+def test_summary_and_reduce_lanes_windows_integration():
+    """summary_to_dict / reduce_lanes grow the windows block only
+    when a WindowSummary rides along (additive schema)."""
+    import jax
+
+    base = dict(
+        msgs=np.arange(7, dtype=np.int32),
+        offered=np.full(7, 100, np.int32),
+        dropped=np.full(7, 5, np.int32),
+        duped=np.full(7, 2, np.int32),
+        delayed=np.full(7, 3, np.int32),
+        learns=np.int32(48), commit_acks=np.int32(9),
+        takeovers=np.int32(1), requeues=np.int32(4),
+        restarts=np.int32(2), decided=np.int32(16),
+        lat_hist=np.asarray([0, 8, 0, 8, 0, 0, 0, 0, 0, 0], np.int32),
+        lat_max=np.int32(5), heal_gap=np.int32(24),
+        stall_max=np.int32(3), duel_max=np.int32(4),
+        takeover_round=np.asarray([7, -1], np.int32),
+        rounds=np.int32(34), quiescent=np.bool_(True),
+    )
+    s = telem.TelemetrySummary(**base)
+    assert "windows" not in telem.summary_to_dict(s)
+    d = telem.summary_to_dict(s, _mk_windows(), 16)
+    assert d["windows"]["window_rounds"] == 16
+    stack = jax.tree.map(lambda *xs: np.stack(xs), s, s)
+    wstack = jax.tree.map(
+        lambda *xs: np.stack(xs), _mk_windows(), _mk_windows()
+    )
+    assert "windows" not in telem.reduce_lanes(stack)
+    agg = telem.reduce_lanes(stack, wstack, 16)
+    assert agg["windows"]["decided"][0] == 8
+    # the stress block and the search margins ride the same seam;
+    # reports without a windows stack stay schema-compatible
+    from tpu_paxos.fleet import search as fsearch
+    from tpu_paxos.harness import stress
+
+    from tpu_paxos.config import FaultConfig, SimConfig
+
+    cfg = SimConfig(n_nodes=3, proposers=(0, 1), n_instances=16,
+                    faults=FaultConfig(drop_rate=450))
+    rep = types.SimpleNamespace(telemetry=stack, windows=wstack)
+    blk = stress._mix_telemetry(rep, cfg)
+    assert blk["windows"]["decided"] == agg["windows"]["decided"]
+    mar = fsearch._generation_margins(rep)
+    assert mar["stall_margin_series"][1] == 3  # patience 8 - stall 5
+    assert mar["latency_p99_series"] == agg["windows"]["latency_p99"]
+    bare = types.SimpleNamespace(telemetry=stack, windows=None)
+    assert "windows" not in stress._mix_telemetry(bare, cfg)
+    assert "stall_margin_series" not in fsearch._generation_margins(bare)
+
+
+# ---------------- on-device reduction edge cases ----------------
+
+
+def test_window_bucket_boundaries():
+    """Rounds landing exactly on a bucket boundary open the NEXT
+    bucket; everything past the grid clamps into the overflow."""
+    ts = np.asarray(
+        [0, 15, 16, 17, 31, 32, 16 * (W - 1) - 1, 16 * (W - 1), 10_000]
+    )
+    got = [int(telem.window_bucket(t, 16)) for t in ts]
+    assert got == [0, 0, 1, 1, 1, 2, W - 2, W - 1, W - 1]
+
+
+def test_summarize_windows_run_shorter_than_one_bucket():
+    """A run that finishes inside bucket 0 puts its whole series
+    there — no spill, no dilution."""
+    import jax.numpy as jnp
+
+    wins = telem.init_windows()
+    chosen_vid = jnp.asarray([100, 101, -1, 102], jnp.int32)
+    chosen_round = jnp.asarray([3, 7, -1, 9], jnp.int32)
+    admit = jnp.asarray([1, 1, -1, 2], jnp.int32)
+    ws = telem.summarize_windows(wins, admit, chosen_vid, chosen_round, 16)
+    decided = np.asarray(ws.decided)
+    assert decided[0] == 3 and decided[1:].sum() == 0
+    hist = np.asarray(ws.lat_hist)
+    assert hist[0].sum() == 3 and hist[1:].sum() == 0
+    # latencies 2, 6, 7 -> buckets (1,2], (4,8], (4,8]
+    assert hist[0][1] == 1 and hist[0][3] == 2
+
+
+def test_summarize_windows_boundary_and_overflow():
+    """A decision exactly ON the bucket boundary lands in the next
+    bucket; decisions past the grid clamp into the overflow bucket;
+    undecided instances and NONE admissions (no-op fills) never
+    enter the series."""
+    import jax.numpy as jnp
+
+    wins = telem.init_windows()
+    hi = 16 * (W + 3)  # far past the grid
+    chosen_vid = jnp.asarray([100, 101, 102, -1, -3], jnp.int32)
+    chosen_round = jnp.asarray([15, 16, hi, -1, 20], jnp.int32)
+    #                           b0  b1  overflow    noop fill (b1)
+    admit = jnp.asarray([10, 10, 10, -1, -1], jnp.int32)
+    ws = telem.summarize_windows(wins, admit, chosen_vid, chosen_round, 16)
+    decided = np.asarray(ws.decided)
+    assert decided[0] == 1 and decided[1] == 2  # noop decides in b1
+    assert decided[W - 1] == 1
+    hist = np.asarray(ws.lat_hist)
+    assert hist[0].sum() == 1 and hist[1].sum() == 1  # noop: no latency
+    assert hist[W - 1].sum() == 1
+    assert int(ws.lat_hist[W - 1].sum()) == 1
+    # accumulated rings pass through untouched
+    assert (np.asarray(ws.offered) == 0).all()
+
+
+# ---------------- the SLO burn-rate arithmetic ----------------
+
+
+def _slo_windows_dict(lat_hist, wr=32):
+    return {"window_rounds": wr, "lat_hist": np.asarray(lat_hist)}
+
+
+def test_slo_windows_burn_and_breach():
+    hist = np.zeros((W, B), np.int64)
+    hist[0, 3] = 9   # (4, 8]: good at threshold 8
+    hist[0, 5] = 1   # (16, 32]: bad -> 10% in window 0
+    hist[3, 3] = 2
+    hist[3, 6] = 2   # 50% bad in window 3: the breach
+    slo = sh.ServeSLO(latency_rounds=8, budget_milli=200)
+    got = sh.slo_windows(_slo_windows_dict(hist), slo)
+    assert got["latency_rounds_effective"] == 8
+    assert got["decided"][0] == 10 and got["bad"][0] == 1
+    assert got["burn"][0] == 0.5  # 10% of a 20% budget
+    assert got["burn"][3] == 2.5
+    assert got["breach_windows"] == [3]
+    assert got["breach_spans"] == [[96, 128]]
+    assert got["burn_max"] == 2.5 and not got["ok"]
+    # run-total: 3 bad of 14 = 214.3 millis > 200 budget
+    assert got["total_bad_milli"] == 214.3 and not got["total_ok"]
+    # empty series: vacuously green
+    clean = sh.slo_windows(
+        _slo_windows_dict(np.zeros((W, B), np.int64)), slo
+    )
+    assert clean["ok"] and clean["total_ok"] and clean["burn_max"] == 0.0
+
+
+def test_slo_threshold_quantizes_down_to_edge_grid():
+    hist = np.zeros((W, B), np.int64)
+    hist[0, 4] = 4  # (8, 16]
+    # threshold 10 quantizes DOWN to edge 8: the (8, 16] mass is bad
+    slo = sh.ServeSLO(latency_rounds=10, budget_milli=500)
+    got = sh.slo_windows(_slo_windows_dict(hist), slo)
+    assert got["latency_rounds_effective"] == 8
+    assert got["bad"][0] == 4 and not got["ok"]
+    # at 16 the same mass is good
+    slo16 = sh.ServeSLO(latency_rounds=16, budget_milli=500)
+    assert sh.slo_windows(_slo_windows_dict(hist), slo16)["ok"]
+
+
+# ---------------- windowed Perfetto counter tracks ----------------
+
+
+def test_window_counter_tracks_render():
+    d = telem.windows_to_dict(_mk_windows(), 16, lat_max=14)
+    evs = texport._window_counter_events(d, tele_pid=7)
+    names = {e["name"] for e in evs}
+    assert {"latency p50 (rounds)", "latency p99 (rounds)",
+            "drop rate (/1e4)", "decided / window",
+            "stall depth", "takeovers / window"} <= names
+    assert all(e["ph"] == "C" and e["pid"] == 7 for e in evs)
+    # counters step on the window grid, in trace time
+    dec = [e for e in evs if e["name"] == "decided / window"]
+    assert [e["ts"] for e in dec] == [
+        w * 16 * texport.ROUND_US for w in range(W)
+    ]
+    # empty-bucket quantiles (-1) are skipped, not rendered as dips
+    p50 = [e for e in evs if e["name"] == "latency p50 (rounds)"]
+    assert len(p50) == 2  # only buckets 0 and 2 decided anything
+    assert {e["args"]["latency p50 (rounds)"] for e in p50} == {2, 14}
+
+
+def test_decision_cap_annotation_visible():
+    """The decision-instant cap must announce itself IN the trace: a
+    'dropped' instant on the decision track plus the otherData
+    counts, controlled by max_decision_events."""
+    from tpu_paxos.config import SimConfig
+
+    cfg = SimConfig(n_nodes=3, proposers=(0, 1), n_instances=8)
+    result = types.SimpleNamespace(
+        chosen_vid=np.arange(100, 108, dtype=np.int32),
+        chosen_round=np.arange(1, 9, dtype=np.int32),
+        chosen_ballot=np.ones(8, np.int32),
+        rounds=10, done=True,
+    )
+    trace = texport.chrome_trace(cfg, result, None,
+                                 max_decision_events=3)
+    evs = trace["traceEvents"]
+    dec = [e for e in evs if e["name"].startswith("decide [")]
+    assert len(dec) == 3
+    drop = [e for e in evs if "decision instants dropped" in e["name"]]
+    assert len(drop) == 1
+    assert drop[0]["args"] == {"dropped": 5, "cap": 3}
+    # the annotation sits at the LAST rendered decision's round, so
+    # it marks exactly where the timeline goes dark
+    assert drop[0]["ts"] == dec[-1]["ts"]
+    assert trace["otherData"]["decision_events_dropped"] == 5
+    assert trace["otherData"]["decision_events_cap"] == 3
+    # under the cap: no annotation, zero dropped
+    full = texport.chrome_trace(cfg, result, None)
+    assert trace["otherData"]["decided"] == 8
+    assert full["otherData"]["decision_events_dropped"] == 0
+    assert not [e for e in full["traceEvents"]
+                if "dropped" in e["name"]]

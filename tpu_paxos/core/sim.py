@@ -405,6 +405,7 @@ def build_engine(
     runtime_schedule: bool = False,
     runtime_knobs: bool = False,
     telemetry: bool = False,
+    window_rounds: int = 0,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
@@ -420,6 +421,16 @@ def build_engine(
     ``telemetry=False`` traces the exact pre-recorder program.
     Unsupported together with ``axis_name`` (the sharded path keeps
     its per-shard state replication argument recorder-free for now).
+
+    A nonzero ``window_rounds`` (telemetry only) additionally arms
+    the WINDOWED time-series plane: ``tele`` becomes a ``(Telemetry,
+    TelemetryWindows)`` pair and the recorder block also buckets the
+    fault-layer counters, stall depth, and takeover/restart events by
+    virtual round into ``[NUM_WINDOWS]`` rings (bucket width =
+    ``window_rounds`` rounds, last bucket overflow).  Still strictly
+    read-only — the same neutrality contract and sha256 parity hold,
+    and ``window_rounds=0`` traces the exact pre-windowing armed
+    program.
 
     With ``runtime_knobs=True`` the i.i.d. fault knobs are NOT baked
     in either: ``round_fn(root, state, tab, knobs)`` takes a traced
@@ -491,6 +502,12 @@ def build_engine(
             "telemetry is not supported on the sharded engine yet "
             "(the recorder's per-instance ledger is unsharded)"
         )
+    if window_rounds and not telemetry:
+        raise ValueError(
+            "window_rounds arms the recorder's windowed plane; it "
+            "requires telemetry=True"
+        )
+    _ww = int(window_rounds)
     if telemetry:
         from tpu_paxos.telemetry import recorder as _rec
     if runtime_schedule:
@@ -1773,6 +1790,8 @@ def build_engine(
         # Every field below reduces values the round already computed;
         # nothing here samples PRNG streams or writes back into the
         # state, so the armed engine stays decision-log-identical.
+        if _ww:
+            tele, wins = tele  # windowed builds carry the pair
         tc = [_rec.count_copies(al_, dl_, m_) for (al_, dl_, m_) in _tsites]
         cv_new = (commit_vid != val.NONE) & (pr.commit_vid == val.NONE)
         took = cv_new & ~newly  # [P, I] commit-takeover adoptions
@@ -1800,7 +1819,28 @@ def build_engine(
             ),
             stall_max=jnp.maximum(tele.stall_max, jnp.max(stall)),
         )
-        return new_st, new_tele
+        if not _ww:
+            return new_st, new_tele
+        # Windowed plane: the same already-computed values, bucketed
+        # by the virtual round (decision-time series are derived at
+        # the epilogue from chosen_round — no accumulation needed).
+        wb = _rec.window_bucket(t, _ww)
+        new_wins = _rec.TelemetryWindows(
+            offered=wins.offered.at[wb].add(
+                sum(c[0] for c in tc)
+            ),
+            dropped=wins.dropped.at[wb].add(sum(c[1] for c in tc)),
+            duped=wins.duped.at[wb].add(sum(c[2] for c in tc)),
+            delayed=wins.delayed.at[wb].add(sum(c[3] for c in tc)),
+            stall_max=wins.stall_max.at[wb].max(jnp.max(stall)),
+            takeovers=wins.takeovers.at[wb].add(
+                jnp.sum(took, dtype=jnp.int32)
+            ),
+            restarts=wins.restarts.at[wb].add(
+                jnp.sum(do_restart, dtype=jnp.int32)
+            ),
+        )
+        return new_st, (new_tele, new_wins)
 
     return round_fn
 
@@ -1995,18 +2035,22 @@ def _run_loop_knobs(cfg: SimConfig, round_fn):
     return _go
 
 
-def _run_loop_telemetry(cfg: SimConfig, round_fn):
+def _run_loop_telemetry(cfg: SimConfig, round_fn, window_rounds: int = 0):
     """Whole-run driver for a ``telemetry=True`` engine: the loop
     carries ``(state, Telemetry)`` and the epilogue reduces the
     recorder to its fixed-shape :class:`TelemetrySummary` INSIDE the
     same jit — the per-instance admission ledger never crosses to
     host (IR201 holds: no transfers in the loop body either).  This
-    is the surface the IR audit traces as
-    ``sim.run_rounds_telemetry``."""
+    is the surface the IR audit traces as ``sim.run_rounds_telemetry``
+    (and, with a nonzero ``window_rounds`` matching the engine build,
+    as ``sim.run_rounds_timeseries``: the carry's telemetry leg is the
+    ``(Telemetry, TelemetryWindows)`` pair and the epilogue also
+    closes the windowed series)."""
     from tpu_paxos.telemetry import recorder as telem
 
     sched = cfg.faults.schedule
     horizon = sched.horizon if sched is not None else 0
+    ww = int(window_rounds)
 
     @jax.jit
     def _go(root, state, tele):
@@ -2017,7 +2061,17 @@ def _run_loop_telemetry(cfg: SimConfig, round_fn):
             return round_fn(root, c[0], tele=c[1])
 
         final, tl = jax.lax.while_loop(cond, body, (state, tele))
-        return final, telem.summarize(tl, final, horizon)
+        if not ww:
+            return final, telem.summarize(tl, final, horizon)
+        base, wins = tl
+        return (
+            final,
+            telem.summarize(base, final, horizon),
+            telem.summarize_windows(
+                wins, base.admit_round, final.met.chosen_vid,
+                final.met.chosen_round, ww,
+            ),
+        )
 
     return _go
 
@@ -2026,13 +2080,21 @@ def run_with_telemetry(
     cfg: SimConfig,
     workload: list[np.ndarray] | None = None,
     gates: list[np.ndarray] | None = None,
+    window_rounds: int | None = None,
 ):
     """``run()`` with the flight recorder armed: returns ``(SimResult,
-    TelemetrySummary)`` (summary fields as host numpy).  Decision-log
-    identical to ``run()`` for the same (cfg, workload, gates) — the
-    recorder is read-only (parity pinned by tests/test_telemetry.py)."""
+    TelemetrySummary, WindowSummary | None)`` (summary fields as host
+    numpy).  Decision-log identical to ``run()`` for the same (cfg,
+    workload, gates) — the recorder is read-only (parity pinned by
+    tests/test_telemetry.py).  ``window_rounds`` sets the windowed
+    plane's bucket width (default :data:`~tpu_paxos.telemetry.
+    recorder.WINDOW_ROUNDS`; pass 0 for the window-free PR-6-shaped
+    recorder, whose WindowSummary slot comes back None)."""
     from tpu_paxos.telemetry import recorder as telem
 
+    if window_rounds is None:
+        window_rounds = telem.WINDOW_ROUNDS
+    ww = int(window_rounds)
     if workload is None:
         workload = default_workload(cfg)
     pend, gate, tail, c = prepare_queues(cfg, workload, gates)
@@ -2042,13 +2104,22 @@ def run_with_telemetry(
         np.concatenate([np.asarray(w, np.int32).reshape(-1) for w in workload])
     )
     round_fn = build_engine(
-        cfg, c, vid_cap=gates_vid_cap(workload, gates), telemetry=True
+        cfg, c, vid_cap=gates_vid_cap(workload, gates), telemetry=True,
+        window_rounds=ww,
     )
-    _go = _run_loop_telemetry(cfg, round_fn)
+    _go = _run_loop_telemetry(cfg, round_fn, window_rounds=ww)
     tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+    if ww:
+        tele0 = (tele0, telem.init_windows())
     with tracecount.engine_scope("sim"):
-        final, summ = _go(root, state, tele0)
-    return to_result(final, expected), jax.tree.map(np.asarray, summ)
+        out = _go(root, state, tele0)
+    final, summ = out[0], out[1]
+    wsum = out[2] if ww else None
+    return (
+        to_result(final, expected),
+        jax.tree.map(np.asarray, summ),
+        jax.tree.map(np.asarray, wsum) if wsum is not None else None,
+    )
 
 
 def to_result(final: SimState, expected_vids: np.ndarray) -> SimResult:
@@ -2204,6 +2275,44 @@ def audit_entries():
         tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
         return _run_loop_telemetry(cfg, rf), (root, state, tele0)
 
+    def build_timeseries():
+        # The windowed time-series plane: the telemetry build above
+        # PLUS the [W] metric rings in the loop carry and the
+        # summarize_windows epilogue (per-bucket commit counts and
+        # latency deltas from the decision metrics).  Same
+        # episode-schedule config so the windowed fault-layer
+        # counters are in the pinned program; sim.run_rounds_telemetry
+        # stays the window-free armed program — window_rounds=0 must
+        # keep tracing the exact pre-windowing recorder.
+        from tpu_paxos.telemetry import recorder as telem
+
+        sched = fltm.FaultSchedule((
+            fltm.partition(2, 10, (0,), (1, 2)),
+            fltm.pause(3, 8, 2),
+            fltm.burst(4, 9, 1500),
+        ))
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000,
+                               schedule=sched),
+        )
+        workload = default_workload(cfg)
+        pend, gate, tail, c = prepare_queues(cfg, workload, None)
+        root = prng.root_key(cfg.seed)
+        state = init_state(cfg, pend, gate, tail, root)
+        ww = telem.WINDOW_ROUNDS
+        rf = build_engine(
+            cfg, c, vid_cap=0, telemetry=True, window_rounds=ww
+        )
+        tele0 = (
+            telem.init_telemetry(cfg.n_instances, len(cfg.proposers)),
+            telem.init_windows(),
+        )
+        return (
+            _run_loop_telemetry(cfg, rf, window_rounds=ww),
+            (root, state, tele0),
+        )
+
     def build_gates():
         # Gate-bearing config: a nonzero vid_cap puts the gate-
         # membership bitmap and the gated-admission logic in the
@@ -2249,6 +2358,10 @@ def audit_entries():
         AuditEntry(
             "sim.run_rounds_telemetry", build_telemetry,
             covers=("_run_loop_telemetry",),
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
+        ),
+        AuditEntry(
+            "sim.run_rounds_timeseries", build_timeseries,
             allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(

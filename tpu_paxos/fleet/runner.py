@@ -93,6 +93,11 @@ class FleetReport:
     #: was built with ``telemetry=True``.  Reduced ON DEVICE inside
     #: the lane jit; only these fixed small shapes ever transfer.
     telemetry: object = None
+    #: windowed time-series, [lanes, W]-leading host numpy
+    #: (telemetry/recorder.WindowSummary) — armed runners always
+    #: carry the windowed plane (bucket width
+    #: ``recorder.WINDOW_ROUNDS``); None when recorder-free.
+    windows: object = None
     #: per-lane i.i.d. FaultConfig (schedule-free) — the knob mix each
     #: lane actually ran, whether passed explicitly or defaulted from
     #: the runner's base cfg; the source ``lane_cfg`` bakes back in.
@@ -118,14 +123,19 @@ class FleetReport:
 
     def lane_telemetry(self, i: int):
         """One lane's flight-recorder summary as a JSON-ready dict
-        (telemetry/recorder.summary_to_dict); None when the runner
-        ran recorder-free."""
+        (telemetry/recorder.summary_to_dict, incl. the windowed
+        ``"windows"`` block); None when the runner ran
+        recorder-free."""
         if self.telemetry is None:
             return None
         from tpu_paxos.telemetry import recorder as telem
 
         one = jax.tree.map(lambda x: x[i], self.telemetry)
-        return telem.summary_to_dict(one)
+        wone = (
+            jax.tree.map(lambda x: x[i], self.windows)
+            if self.windows is not None else None
+        )
+        return telem.summary_to_dict(one, wone, telem.WINDOW_ROUNDS)
 
     def lane_cfg(self, i: int) -> SimConfig:
         """The single-run config this lane is decision-log-identical
@@ -191,12 +201,15 @@ class FleetRunner:
         self._tmpl = (pend, gate, tail)
         self.queue_cap = c
         self._gate_vid_cap = simm.gates_vid_cap(self.workload, gates)
+        if telemetry:
+            from tpu_paxos.telemetry import recorder as _telem
         round_fn = simm.build_engine(
             cfg, c,
             vid_cap=self._gate_vid_cap,
             runtime_schedule=True,
             runtime_knobs=True,
             telemetry=telemetry,
+            window_rounds=_telem.WINDOW_ROUNDS if telemetry else 0,
         )
         vid_bound = self.vid_bound
 
@@ -210,11 +223,17 @@ class FleetRunner:
                     )
 
                 # the zeroed accumulators are trace-time constants —
-                # no lane-axis plumbing needed
-                tele0 = telem.init_telemetry(
-                    cfg.n_instances, len(cfg.proposers)
+                # no lane-axis plumbing needed; armed lanes always
+                # carry the windowed plane (bucket width
+                # recorder.WINDOW_ROUNDS — part of the envelope's
+                # traced program, shared by every armed consumer)
+                tele0 = (
+                    telem.init_telemetry(
+                        cfg.n_instances, len(cfg.proposers)
+                    ),
+                    telem.init_windows(),
                 )
-                final, tl = jax.lax.while_loop(
+                final, (tl, ws) = jax.lax.while_loop(
                     cond,
                     lambda c: round_fn(root, c[0], tab, kn, tele=c[1]),
                     (st, tele0),
@@ -223,6 +242,10 @@ class FleetRunner:
                     final,
                     vdt.lane_verdict(cfg, final, exp, own, vid_cap=vid_bound),
                     telem.summarize(tl, final, tab.horizon),
+                    telem.summarize_windows(
+                        ws, tl.admit_round, final.met.chosen_vid,
+                        final.met.chosen_round, telem.WINDOW_ROUNDS,
+                    ),
                 )
         else:
             def lane(root, st, tab, kn, exp, own):
@@ -246,7 +269,7 @@ class FleetRunner:
             fl = pmesh.shard_map(
                 fl, mesh,
                 in_specs=(spec,) * 6,
-                out_specs=(spec,) * (3 if telemetry else 2),
+                out_specs=(spec,) * (4 if telemetry else 2),
             )
         self._fn = jax.jit(fl)
 
@@ -432,7 +455,7 @@ class FleetRunner:
             n_lanes, workloads
         )
         t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
-        tsum = None
+        tsum = wsum = None
         with tracecount.engine_scope("fleet"):
             states = self._init(
                 jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail),
@@ -444,12 +467,13 @@ class FleetRunner:
                 jnp.asarray(exp), jnp.asarray(own),
             )
             if self.telemetry:
-                final, v, tsum = out
+                final, v, tsum, wsum = out
             else:
                 final, v = out
         verdict = vdt.LaneVerdict(*(np.asarray(x) for x in v))
         if tsum is not None:
             tsum = jax.tree.map(np.asarray, tsum)
+            wsum = jax.tree.map(np.asarray, wsum)
         seconds = time.perf_counter() - t0  # verdict transfer = the sync  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         return FleetReport(
             cfg=self.cfg,
@@ -461,6 +485,7 @@ class FleetRunner:
             expected=self.expected,
             seconds=seconds,
             telemetry=tsum,
+            windows=wsum,
             fault_cfgs=fault_cfgs,
             expected_lanes=exp_list,
         )
@@ -525,10 +550,12 @@ def audit_entries():
             allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(
-            # the telemetry-armed twin: recorder accumulators in the
-            # lane carry + the on-device summary reduction; IR201
-            # (no host transfers in the loop) is the load-bearing
-            # contract here — the ledger must never leave the device
+            # the telemetry-armed twin: recorder accumulators (incl.
+            # the [W] windowed rings — armed lanes always carry the
+            # windowed plane) in the lane carry + the on-device
+            # summary/window reductions; IR201 (no host transfers in
+            # the loop) is the load-bearing contract here — the
+            # ledger must never leave the device
             "fleet.run_lanes_telemetry", lambda: _build(True),
             allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),

@@ -132,17 +132,37 @@ def _generation_margins(rep) -> dict:
     the near-miss margin vector: the closest any lane came to a
     liveness wedge (prep for ROADMAP item 2's fitness selection).
     Margins shrink as lanes get closer to wedging — a fitness
-    function minimizes heal_gap and maximizes the depth fields."""
+    function minimizes heal_gap and maximizes the depth fields.
+
+    The windowed SERIES fields turn the scalar margins into a
+    trajectory the selection loop can climb: ``stall_margin_series``
+    is, per virtual-clock bucket, the minimum over lanes of the
+    stall headroom left before the engine's idle-restart/takeover
+    patience (``core/sim.IDLE_RESTART_ROUNDS``) trips — a bucket at
+    or below 0 means some lane actually stalled out there — and
+    ``latency_p99_series``/``drop_series`` localize the latency and
+    loss pressure to the buckets that produced them.  JSON schema
+    stays additive: the scalar keys are unchanged."""
+    from tpu_paxos.core.sim import IDLE_RESTART_ROUNDS
     from tpu_paxos.telemetry import recorder as telem
 
     ts = rep.telemetry
     if ts is None:
         return {}
-    agg = telem.reduce_lanes(ts)
-    return {k: agg[k] for k in (
+    ws = getattr(rep, "windows", None)
+    agg = telem.reduce_lanes(ts, ws)
+    out = {k: agg[k] for k in (
         "heal_gap_min", "stall_depth_max", "duel_depth_max",
         "rounds_max", "takeovers", "latency_p99", "latency_max",
     )}
+    if ws is not None:
+        out["window_rounds"] = agg["windows"]["window_rounds"]
+        out["stall_margin_series"] = telem.stall_margin_series(
+            ws, IDLE_RESTART_ROUNDS
+        )
+        out["latency_p99_series"] = agg["windows"]["latency_p99"]
+        out["drop_series"] = agg["windows"]["dropped"]
+    return out
 
 
 def search(

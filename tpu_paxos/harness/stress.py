@@ -293,13 +293,19 @@ def _mix_telemetry(rep, cfg: SimConfig) -> dict:
     drops over fault-layer offered edges, in the knob's per-1e4
     units.  For burst-free mixes it should straddle the configured
     ``drop_rate``; burst episodes push it above (their windows add to
-    the sampled rate)."""
+    the sampled rate).
+
+    The ``windows`` column is the TIME-RESOLVED view of the same
+    lanes (telemetry/recorder.reduce_lanes_windows): per-bucket
+    latency quantiles, drop counts, and stall depth over the virtual
+    clock, so a mix's latency blowout can be read against the bucket
+    its episodes live in rather than smeared over the whole run."""
     from tpu_paxos.telemetry import recorder as telem
 
     ts = rep.telemetry
     if ts is None:
         return {}
-    agg = telem.reduce_lanes(ts)
+    agg = telem.reduce_lanes(ts, getattr(rep, "windows", None))
     offered, dropped = agg["offered"], agg["dropped"]
     return {
         **{k: agg[k] for k in (
@@ -308,6 +314,7 @@ def _mix_telemetry(rep, cfg: SimConfig) -> dict:
             "decided", "takeovers", "requeues", "restarts",
             "heal_gap_min", "stall_depth_max", "duel_depth_max",
         )},
+        **({"windows": agg["windows"]} if "windows" in agg else {}),
         "drop_rate_configured": cfg.faults.drop_rate,
         "drop_rate_observed": (
             round(1e4 * dropped / offered, 1) if offered else 0.0

@@ -67,7 +67,10 @@ class ServeLoopState(NamedTuple):
     requires an all-array donated arg)."""
 
     sim: object  # simm.SimState — engine state incl. the queue ring
-    tele: object  # telem.Telemetry — recorder accumulators
+    tele: object  # telem.Telemetry — recorder accumulators; on a
+    #     windowed build (window_rounds > 0) the (Telemetry,
+    #     TelemetryWindows) pair — the [W] series rings ride the same
+    #     donated arg and chain on device like every other buffer
     ingest: object  # [V] int32 arrival round per vid (NONE: never)
 
 
@@ -96,13 +99,18 @@ def vid_bound_of(workload) -> int:
 
 
 def init_serve_state(
-    cfg: SimConfig, workload, vid_bound: int, root
+    cfg: SimConfig, workload, vid_bound: int, root,
+    window_rounds: int = 0,
 ) -> tuple[ServeLoopState, int]:
     """Fresh loop state for one serve run: empty queues, zeroed
-    recorder, all-NONE ingest table.  Returns ``(state, queue_cap)``."""
+    recorder (plus zeroed ``[W]`` window rings when ``window_rounds``
+    is nonzero — must match the builder's), all-NONE ingest table.
+    Returns ``(state, queue_cap)``."""
     pend, gate, tail, c = empty_queues(cfg, workload)
     st = simm.init_state(cfg, pend, gate, tail, root)
     tele = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+    if window_rounds:
+        tele = (tele, telem.init_windows())
     ingest = jnp.full((int(vid_bound),), val.NONE, jnp.int32)
     return ServeLoopState(sim=st, tele=tele, ingest=ingest), c
 
@@ -112,6 +120,7 @@ def build_serve_window(
     queue_cap: int,
     vid_bound: int,
     rounds_per_window: int,
+    window_rounds: int = 0,
 ):
     """Compile-time closure for one serving envelope: the jitted
     ``serve_window(ss, root, admits, arrs) -> (ss, done, t, summary)``
@@ -121,13 +130,27 @@ def build_serve_window(
     ``K`` (admit width) are call shapes, so a run reusing one
     ``(S, K)`` pair shares one executable and the ``S = 1``
     sequential-dispatch baseline is the SAME program at a different
-    shape.  Use :func:`window_for` for the cached builder."""
+    shape.  Use :func:`window_for` for the cached builder.
+
+    A nonzero ``window_rounds`` arms the recorder's WINDOWED
+    time-series plane (the serving default — harness.serve_run aligns
+    the bucket width with its admission windows): the loop state's
+    telemetry leg becomes the ``(Telemetry, TelemetryWindows)`` pair,
+    and the epilogue additionally closes the windowed series with the
+    ingest-time admission stamps (``summarize_windows``), so every
+    dispatch hands the harness per-bucket p50/p99 as a STREAM — the
+    call returns ``(ss, done, t, summary, window_summary)``.  The
+    trajectory is identical either way (the recorder is read-only);
+    ``window_rounds=0`` traces the exact pre-windowing program."""
     if cfg.faults.schedule is not None:
         raise ValueError(
             "serve engines take no fault schedule (correlated-fault "
             "serving rides the fleet envelope, not this driver)"
         )
-    round_fn = simm.build_engine(cfg, queue_cap, vid_cap=0, telemetry=True)
+    ww = int(window_rounds)
+    round_fn = simm.build_engine(
+        cfg, queue_cap, vid_cap=0, telemetry=True, window_rounds=ww
+    )
     r = int(rounds_per_window)
     v_bound = int(vid_bound)
 
@@ -160,8 +183,15 @@ def build_serve_window(
         # (serve_admit_rounds) — the closed-loop ledger reduction,
         # inside the same jit; nothing per-instance crosses to host.
         adm = telem.serve_admit_rounds(ingest, st.met.chosen_vid)
-        summ = telem.summarize(tl._replace(admit_round=adm), st, 0)
-        return ServeLoopState(st, tl, ingest), st.done, st.t, summ
+        if not ww:
+            summ = telem.summarize(tl._replace(admit_round=adm), st, 0)
+            return ServeLoopState(st, tl, ingest), st.done, st.t, summ
+        base, wins = tl
+        summ = telem.summarize(base._replace(admit_round=adm), st, 0)
+        wsum = telem.summarize_windows(
+            wins, adm, st.met.chosen_vid, st.met.chosen_round, ww
+        )
+        return ServeLoopState(st, tl, ingest), st.done, st.t, summ, wsum
 
     return jax.jit(serve_window, donate_argnums=(0,))
 
@@ -175,14 +205,15 @@ def clear_cache() -> None:
 
 
 def window_for(
-    cfg: SimConfig, queue_cap: int, vid_bound: int, rounds_per_window: int
+    cfg: SimConfig, queue_cap: int, vid_bound: int, rounds_per_window: int,
+    window_rounds: int = 0,
 ):
     """Envelope-keyed cache over :func:`build_serve_window` (the
     ``fleet/envelope.runner_for`` discipline): a knee sweep's rate
     points and the bench's dispatch-granularity twins all reuse ONE
     cached builder per (geometry, protocol, knobs, queue shape, vid
-    space, window span) — and per seeded-wedge flag, which selects a
-    different traced engine."""
+    space, window span, windowed-plane bucket width) — and per
+    seeded-wedge flag, which selects a different traced engine."""
     if cfg.faults.schedule is not None:
         # checked HERE, not just in build_serve_window: the key below
         # ignores the schedule (serve engines never take one), so a
@@ -208,10 +239,14 @@ def window_for(
         int(queue_cap),
         int(vid_bound),
         int(rounds_per_window),
+        int(window_rounds),
     )
     fn = _CACHE.get(key)
     if fn is None:
-        fn = build_serve_window(cfg, queue_cap, vid_bound, rounds_per_window)
+        fn = build_serve_window(
+            cfg, queue_cap, vid_bound, rounds_per_window,
+            window_rounds=window_rounds,
+        )
         _CACHE[key] = fn
     return fn
 
@@ -232,6 +267,10 @@ def audit_entries():
     from tpu_paxos.core.sim import audit_canonical_cfg
 
     r_window, s_windows, k_admit = 8, 2, 4
+    # the product path is WINDOWED (harness.serve_run's default): the
+    # [W] series rings ride the donated loop state and the aliasing
+    # checker must account for every one of their leaves too
+    w_rounds = r_window * 4
 
     def _setup():
         cfg = dataclasses.replace(
@@ -241,8 +280,10 @@ def audit_entries():
         workload = simm.default_workload(cfg)
         v_bound = vid_bound_of(workload)
         root = prng.root_key(cfg.seed)
-        ss, c = init_serve_state(cfg, workload, v_bound, root)
-        fn = window_for(cfg, c, v_bound, r_window)
+        ss, c = init_serve_state(
+            cfg, workload, v_bound, root, window_rounds=w_rounds
+        )
+        fn = window_for(cfg, c, v_bound, r_window, window_rounds=w_rounds)
         p = len(cfg.proposers)
         admits = np.full((s_windows, p, k_admit), int(val.NONE), np.int32)
         arrs = np.zeros((s_windows, p, k_admit), np.int32)

@@ -25,6 +25,17 @@ the plan is precomputed on the virtual clock (serve/arrivals.py) —
 so the bench's "at equal p99" is exact, not approximate (pinned by
 tests/test_serve.py), and the throughput gap is pure
 dispatch-overhead hiding.
+
+The WINDOWED plane (on by default) makes each dispatch's epilogue a
+metrics STREAM, not a run-so-far total: per-virtual-clock-bucket
+latency histograms, drop counts, and stall depth arrive with every
+harvest, and the :class:`ServeSLO` burn-rate monitor judges them per
+dispatch — a latency breach confined to one burst window is named
+(bucket index + round span) even when the run-total histogram ends
+the run green (the breach diluted below the budget by later
+traffic).  ``sweep_load`` carries the verdicts into the sweep
+summary and ``judge_knee`` reads the windowed steady-state median,
+so saturation can't hide behind the warm-up either.
 """
 
 from __future__ import annotations
@@ -51,6 +62,91 @@ ROUNDS_PER_WINDOW = 8
 #: windows/call).  1 = the sequential-dispatch baseline.
 WINDOWS_PER_DISPATCH = 8
 
+#: Default windowed-plane bucket width, in admission windows: each of
+#: the recorder's NUM_WINDOWS time buckets spans this many admission
+#: windows (bucket width = WINDOWS_PER_BUCKET * rounds_per_window
+#: rounds), so the SLO monitor's burn windows stay aligned with the
+#: granularity values actually enter the system at.
+WINDOWS_PER_BUCKET = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeSLO:
+    """A declared serving SLO judged on the WINDOWED latency series.
+
+    A decided value is GOOD when its commit latency (ingest-to-commit,
+    in rounds) is <= ``latency_rounds`` — quantized DOWN to the
+    recorder's histogram edge grid (``telemetry/recorder.LAT_EDGES``),
+    so the per-window good/bad split is exact, never interpolated.
+    ``budget_milli`` is the error budget: the allowed bad fraction per
+    1000 decided values.  The per-window BURN RATE is the window's bad
+    fraction over the budget (the SRE burn-rate convention: burn 1.0
+    = spending the budget exactly; burn 4.0 = spending it 4x too
+    fast), and a window at or above ``burn_breach`` is a named breach
+    window — which is exactly what the run-total histogram cannot
+    see: a mid-run breach that later traffic dilutes below the budget
+    leaves the final histogram green."""
+
+    latency_rounds: int
+    budget_milli: int = 100
+    burn_breach: float = 1.0
+
+
+def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
+    """Judge one run's windowed latency series against ``slo``:
+    per-window totals/bad-counts/burn rates, the named breach
+    windows (with their round spans), and the run-total verdict the
+    windowed one is compared against.  ``windows_dict`` is the
+    recorder's ``windows_to_dict`` output (the ``"windows"`` block of
+    a summary dict) — this function is pure host arithmetic, so the
+    monitor can run per dispatch at no device cost."""
+    import bisect
+
+    from tpu_paxos.telemetry import recorder as telem
+
+    hist = np.asarray(windows_dict["lat_hist"], np.int64)  # [W, B]
+    wr = int(windows_dict["window_rounds"])
+    k = bisect.bisect_right(telem.LAT_EDGES, int(slo.latency_rounds))
+    eff = telem.LAT_EDGES[k - 1] if k else 0
+    tot = hist.sum(axis=1)
+    bad = hist[:, k:].sum(axis=1)
+    budget = max(int(slo.budget_milli), 1) / 1000.0
+    burn = [
+        round(float(b) / float(t) / budget, 3) if t else 0.0
+        for b, t in zip(bad, tot)
+    ]
+    breach = [
+        w for w, bn in enumerate(burn)
+        if tot[w] and bn >= slo.burn_breach
+    ]
+    t_tot, b_tot = int(tot.sum()), int(bad.sum())
+    frac_milli = round(1000.0 * b_tot / t_tot, 1) if t_tot else 0.0
+    return {
+        "latency_rounds": int(slo.latency_rounds),
+        "latency_rounds_effective": int(eff),
+        "budget_milli": int(slo.budget_milli),
+        "burn_breach": float(slo.burn_breach),
+        "window_rounds": wr,
+        "decided": tot.tolist(),
+        "bad": bad.tolist(),
+        "burn": burn,
+        "burn_max": max(burn) if burn else 0.0,
+        "breach_windows": breach,
+        # the overflow bucket aggregates every round past the grid,
+        # so its span is open-ended (null), not one window wide — a
+        # closed [start, start+wr] there would misdirect an operator
+        # to a 1-bucket slice of an arbitrarily long tail
+        "breach_spans": [
+            [w * wr, None if w == len(burn) - 1 else (w + 1) * wr]
+            for w in breach
+        ],
+        "ok": not breach,
+        # the run-total judgment the windowed one exists to correct:
+        # a mid-run breach can hide under a green total
+        "total_bad_milli": frac_milli,
+        "total_ok": frac_milli <= float(slo.budget_milli),
+    }
+
 
 @dataclasses.dataclass
 class ServeReport:
@@ -65,7 +161,7 @@ class ServeReport:
     admit_width: int
     pipelined: bool
     dispatches: int
-    windows: int
+    windows_count: int  # admission windows run (dispatches * S)
     rounds: int
     done: bool
     decided_values: int  # real stamped values decided (hist mass)
@@ -79,6 +175,17 @@ class ServeReport:
     window_decided: list  # per-dispatch cumulative decided counts
     chosen_vid: np.ndarray
     chosen_ballot: np.ndarray
+    #: windowed-plane bucket width in rounds (0 = plane disarmed)
+    window_rounds: int = 0
+    #: the final windowed series (recorder.windows_to_dict) — the
+    #: per-bucket p50/p99/drop/stall stream; None when disarmed
+    windows: dict | None = None
+    #: SLO verdict (slo_windows) — None unless an SLO was declared
+    slo: dict | None = None
+    #: first dispatch (1-based) whose harvested windowed series
+    #: already named a breach window — the burn-rate monitor's
+    #: per-dispatch output; None = never breached (or no SLO)
+    slo_first_breach_dispatch: int | None = None
 
     @property
     def values_per_sec(self) -> float:
@@ -94,6 +201,8 @@ def serve_run(
     windows_per_dispatch: int = WINDOWS_PER_DISPATCH,
     admit_width: int | None = None,
     pipelined: bool = True,
+    window_rounds: int | None = None,
+    slo: ServeSLO | None = None,
 ) -> ServeReport:
     """Serve one value stream open-loop to completion (or the round
     budget).  ``workload[p]`` is proposer ``p``'s vid sequence in
@@ -106,7 +215,16 @@ def serve_run(
     ``windows_per_dispatch`` the amortization depth (one executable
     per ``(S, K)`` call shape across a sweep); admission timing —
     hence the latency distribution — is identical for every ``S``.
-    """
+
+    ``window_rounds`` sets the windowed time-series plane's bucket
+    width (default ``WINDOWS_PER_BUCKET * rounds_per_window``,
+    aligned with admission windows; pass 0 to disarm — the exact
+    pre-windowing program, the bench's overhead baseline).  The
+    bucket width is part of the compiled program, NOT of the
+    trajectory: decisions and the cumulative histogram are identical
+    for every setting.  With an ``slo``, the windowed burn-rate
+    monitor runs per dispatch on the harvested series and the report
+    names every breach window (``ServeReport.slo``)."""
     import jax.numpy as jnp
 
     from tpu_paxos.analysis import tracecount
@@ -128,10 +246,20 @@ def serve_run(
     s = int(windows_per_dispatch)
     if s < 1:
         raise ValueError("windows_per_dispatch must be >= 1")
+    if window_rounds is None:
+        window_rounds = WINDOWS_PER_BUCKET * rounds_per_window
+    ww = int(window_rounds)
+    if slo is not None and not ww:
+        raise ValueError(
+            "the SLO monitor reads the windowed series; "
+            "window_rounds=0 disarms it"
+        )
     v_bound = drv.vid_bound_of(workload)
     root = prng.root_key(cfg.seed)
-    ss, c = drv.init_serve_state(cfg, workload, v_bound, root)
-    fn = drv.window_for(cfg, c, v_bound, rounds_per_window)
+    ss, c = drv.init_serve_state(
+        cfg, workload, v_bound, root, window_rounds=ww
+    )
+    fn = drv.window_for(cfg, c, v_bound, rounds_per_window, window_rounds=ww)
     p = len(cfg.proposers)
     empty = (
         jnp.full((s, p, k), val.NONE, jnp.int32),
@@ -150,36 +278,52 @@ def serve_run(
         r = np.stack([plan.block(d * s + i, k)[1] for i in range(s)])
         return jnp.asarray(a), jnp.asarray(r)
 
+    first_breach: list = []  # [dispatch] set once by the monitor
+
     def harvest(out):
         # the one host sync per dispatch: the stop scalars + the
-        # metrics-plane render of the cumulative summary
-        done, t, summ = out
-        return bool(done), int(t), summ
+        # metrics-plane render of the cumulative summary (and, with
+        # an SLO declared, the windowed burn-rate monitor — pure
+        # host arithmetic on the [W, B] series that just transferred)
+        done, t, summ = out[0], out[1], out[2]
+        wsum = out[3] if ww else None
+        if slo is not None and not first_breach:
+            judged = slo_windows(
+                {"window_rounds": ww,
+                 "lat_hist": np.asarray(wsum.lat_hist)},
+                slo,
+            )
+            if judged["breach_windows"]:
+                first_breach.append(harvested + 1)
+        return bool(done), int(t), summ, wsum
 
     window_decided: list[int] = []
     pending = None
-    last_done, last_t, last_summ = False, 0, None
+    last_done, last_t, last_summ, last_wsum = False, 0, None, None
     d = harvested = 0
     t0 = time.perf_counter()  # paxlint: allow[DET001] wall metric only; never reaches artifacts
     with tracecount.engine_scope("serve"):
         while True:
             blk = super_block(d) if d < n_disp_admit else empty
-            ss, done, t, summ = fn(ss, root, *blk)
+            out = fn(ss, root, *blk)
+            ss = out[0]
             d += 1
             if pipelined:
                 # double buffer: harvest the PREVIOUS dispatch while
                 # this one computes; its scalars are already (or
                 # nearly) resolved, so the poll costs no device idle
                 if pending is not None:
-                    last_done, last_t, last_summ = harvest(pending)
+                    last_done, last_t, last_summ, last_wsum = harvest(
+                        pending
+                    )
                     window_decided.append(int(last_summ.decided))
                     harvested += 1
-                pending = (done, t, summ)
+                pending = out[1:]
             else:
                 # sequential baseline: block on this dispatch before
                 # preparing the next — the bubble the double-buffered
                 # mode exists to hide
-                last_done, last_t, last_summ = harvest((done, t, summ))
+                last_done, last_t, last_summ, last_wsum = harvest(out[1:])
                 window_decided.append(int(last_summ.decided))
                 harvested += 1
             # stop only on a quiescence signal from a dispatch that
@@ -190,8 +334,9 @@ def serve_run(
             if d >= disp_cap:
                 break
         if pending is not None:
-            last_done, last_t, last_summ = harvest(pending)
+            last_done, last_t, last_summ, last_wsum = harvest(pending)
             window_decided.append(int(last_summ.decided))
+            harvested += 1
     wall = time.perf_counter() - t0  # paxlint: allow[DET001] wall metric only; never reaches artifacts
 
     # Post-clock rendering: the final cumulative summary + decision
@@ -199,10 +344,19 @@ def serve_run(
     import jax
 
     host_summ = jax.tree.map(np.asarray, last_summ)
-    sd = telem.summary_to_dict(host_summ)
+    host_wsum = (
+        jax.tree.map(np.asarray, last_wsum) if last_wsum is not None
+        else None
+    )
+    sd = telem.summary_to_dict(host_summ, host_wsum, ww)
     hist = np.asarray(host_summ.lat_hist)
     lat_max = int(host_summ.lat_max)
     decided_values = int(hist.sum())
+    windows_dict = sd.get("windows")
+    slo_dict = (
+        slo_windows(windows_dict, slo)
+        if slo is not None and windows_dict is not None else None
+    )
     return ServeReport(
         cfg=cfg,
         n_values=plan.n_values,
@@ -211,7 +365,7 @@ def serve_run(
         admit_width=k,
         pipelined=pipelined,
         dispatches=d,
-        windows=d * s,
+        windows_count=d * s,
         rounds=last_t,
         done=last_done,
         decided_values=decided_values,
@@ -225,10 +379,38 @@ def serve_run(
         window_decided=window_decided,
         chosen_vid=np.asarray(ss.sim.met.chosen_vid),
         chosen_ballot=np.asarray(ss.sim.met.chosen_ballot),
+        window_rounds=ww,
+        windows=windows_dict,
+        slo=slo_dict,
+        slo_first_breach_dispatch=(
+            first_breach[0] if first_breach else None
+        ),
     )
 
 
+def _steady_p50(rep: ServeReport) -> int | None:
+    """Steady-state median from the windowed series: the MEDIAN of
+    the per-bucket p50s over the buckets that decided anything
+    (later-middle on even counts, leaning toward the loaded end).
+    The run-total p50 averages the unloaded warm-up in, so a run
+    that saturates mid-sweep can average back under the doubling
+    line; a single bucket would be hostage to the straggler drain
+    tail (small-n, retry-biased slow) or a one-off duel cluster —
+    the typical-window median sees sustained queueing and nothing
+    else.  None when the plane is disarmed."""
+    if rep.windows is None:
+        return None
+    # filter on the quantile itself, not the decided count: decided
+    # includes no-op fills (which carry no latency), so a fill-only
+    # bucket reports -1 — a sentinel, not a latency of -1
+    p50s = [int(p) for p in rep.windows["latency_p50"] if int(p) >= 0]
+    if not p50s:
+        return None
+    return sorted(p50s)[len(p50s) // 2]
+
+
 def _point(rate_milli: int, rep: ServeReport) -> dict:
+    steady = _steady_p50(rep)
     return {
         "rate_milli": int(rate_milli),
         "p50": rep.p50,
@@ -240,10 +422,17 @@ def _point(rate_milli: int, rep: ServeReport) -> dict:
         "done": rep.done,
         "rounds": rep.rounds,
         "dispatches": rep.dispatches,
-        "windows": rep.windows,
+        "windows": rep.windows_count,
         "wall_seconds": round(rep.wall_seconds, 4),
         "values_per_sec": round(rep.values_per_sec, 1),
         "sustained": bool(rep.done and rep.backlog == 0),
+        **({
+            "p50_steady": steady,
+            "p50_windows": rep.windows["latency_p50"],
+            "p99_windows": rep.windows["latency_p99"],
+            "window_rounds": rep.window_rounds,
+        } if steady is not None else {}),
+        **({"slo": rep.slo} if rep.slo is not None else {}),
     }
 
 
@@ -256,16 +445,28 @@ def judge_knee(points: list, factor: float = 2.0) -> dict:
     p99: the tail carries the fault-retry ladder (a dropped accept's
     ~100-round restart shows up at p99 even at near-zero load), while
     queueing delay past the engine's service rate moves EVERY value —
-    the median is the saturation signal.  Returns the bracketing
-    rates (None where the sweep never crossed)."""
+    the median is the saturation signal.
+
+    Points carrying the windowed series are judged on ``p50_steady``
+    (the last active bucket's median) instead of the run-total p50:
+    the total smears the unloaded warm-up over the whole run, so a
+    run that saturates mid-sweep can average back under the doubling
+    line — the steady-state median is where queueing actually shows.
+    Returns the bracketing rates (None where the sweep never
+    crossed)."""
     if not points:
         return {"last_sustained_milli": None, "first_saturated_milli": None}
-    base = max(points[0]["p50"], 1)
+
+    def med(pt):
+        return pt.get("p50_steady") or pt["p50"]
+
+    windowed = any("p50_steady" in pt for pt in points)
+    base = max(med(points[0]), 1)
     last_ok, first_bad = None, None
     for pt in points:
         # >=: p50 is latency-bucket-quantized, so the doubling point
         # lands exactly ON factor * base
-        bad = (not pt["sustained"]) or pt["p50"] >= factor * base
+        bad = (not pt["sustained"]) or med(pt) >= factor * base
         if bad and first_bad is None:
             first_bad = pt["rate_milli"]
         if not bad and first_bad is None:
@@ -275,6 +476,7 @@ def judge_knee(points: list, factor: float = 2.0) -> dict:
         "first_saturated_milli": first_bad,
         "p50_factor": factor,
         "p50_base": base,
+        "p50_metric": "p50_steady" if windowed else "p50",
     }
 
 
@@ -289,12 +491,17 @@ def sweep_load(
     pipelined: bool = True,
     knee_factor: float = 2.0,
     admit_width: int | None = None,
+    window_rounds: int | None = None,
+    slo: ServeSLO | None = None,
 ) -> dict:
     """Latency at load: one open-loop Poisson run per offered rate
     (values per 1000 rounds), all sharing ONE compiled window (the
     admit width is the max over every rate's plan — raise it with
     ``admit_width`` to share an executable with runs outside the
-    sweep), plus the knee judgment over the resulting points."""
+    sweep), plus the knee judgment over the resulting points (the
+    windowed steady-state median when the plane is armed — the
+    default).  With an ``slo``, every point carries its burn-rate
+    verdict and the summary names each rate's breach windows."""
     vids = np.arange(int(n_values), dtype=np.int32)
     n_prop = len(cfg.proposers)
     plans = {}
@@ -316,9 +523,11 @@ def sweep_load(
             windows_per_dispatch=windows_per_dispatch,
             admit_width=width,
             pipelined=pipelined,
+            window_rounds=window_rounds,
+            slo=slo,
         )
         points.append(_point(rm, rep))
-    return {
+    out = {
         "metric": "serve_latency_at_load",
         "n_values": int(n_values),
         "rounds_per_window": int(rounds_per_window),
@@ -327,6 +536,22 @@ def sweep_load(
         "points": points,
         "knee": judge_knee(points, knee_factor),
     }
+    if slo is not None:
+        out["slo"] = {
+            "latency_rounds": int(slo.latency_rounds),
+            "budget_milli": int(slo.budget_milli),
+            "burn_breach": float(slo.burn_breach),
+            # every rate's named breach windows — the mid-run
+            # story the per-point run-total columns cannot tell
+            "breach_windows": {
+                str(pt["rate_milli"]): pt["slo"]["breach_windows"]
+                for pt in points if "slo" in pt
+            },
+            "ok": all(
+                pt["slo"]["ok"] for pt in points if "slo" in pt
+            ),
+        }
+    return out
 
 
 def _serve_cfg(args) -> SimConfig:
@@ -379,6 +604,17 @@ def main(argv=None) -> int:
                     help="the naive sequential-dispatch baseline: one "
                     "window per dispatch, block on each before "
                     "preparing the next")
+    ap.add_argument("--window-rounds", type=int, default=-1,
+                    help="windowed time-series bucket width in rounds "
+                    "(-1 = 4 admission windows; 0 disarms the plane)")
+    ap.add_argument("--slo-latency", type=int, default=0,
+                    help="declare a latency SLO: commit latency (in "
+                    "rounds, quantized to the histogram edges) every "
+                    "value should meet; arms the windowed burn-rate "
+                    "monitor (0 = no SLO)")
+    ap.add_argument("--slo-budget-milli", type=int, default=100,
+                    help="SLO error budget: allowed slow-value "
+                    "fraction per 1000 decided (with --slo-latency)")
     ap.add_argument("--instances", type=int, default=0,
                     help="instance-space size (0 = 2x values)")
     ap.add_argument("--seed", type=int, default=0)
@@ -399,6 +635,12 @@ def main(argv=None) -> int:
     cfg = _serve_cfg(args)
     pipelined = not args.sequential
     s_disp = 1 if args.sequential else args.windows_per_dispatch
+    w_rounds = None if args.window_rounds < 0 else args.window_rounds
+    slo = (
+        ServeSLO(latency_rounds=args.slo_latency,
+                 budget_milli=args.slo_budget_milli)
+        if args.slo_latency else None
+    )
     if args.sweep:
         rates = [int(x) for x in args.sweep.split(",") if x.strip()]
         summary = sweep_load(
@@ -406,9 +648,12 @@ def main(argv=None) -> int:
             rounds_per_window=args.rounds_per_window,
             windows_per_dispatch=s_disp,
             pipelined=pipelined,
+            window_rounds=w_rounds,
+            slo=slo,
         )
         summary["ok"] = bool(
             summary["points"] and summary["points"][0]["sustained"]
+            and summary.get("slo", {}).get("ok", True)
         )
     else:
         vids = np.arange(args.values, dtype=np.int32)
@@ -434,6 +679,8 @@ def main(argv=None) -> int:
             rounds_per_window=args.rounds_per_window,
             windows_per_dispatch=s_disp,
             pipelined=pipelined,
+            window_rounds=w_rounds,
+            slo=slo,
         )
         summary = {
             "metric": "serve",
@@ -441,8 +688,15 @@ def main(argv=None) -> int:
             "rate_milli": args.rate_milli,
             **_point(args.rate_milli, rep),
             "latency_hist": rep.summary["latency_hist"],
-            "ok": bool(rep.done and rep.backlog == 0),
+            "ok": bool(
+                rep.done and rep.backlog == 0
+                and (rep.slo is None or rep.slo["ok"])
+            ),
         }
+        if rep.slo_first_breach_dispatch is not None:
+            summary["slo_first_breach_dispatch"] = (
+                rep.slo_first_breach_dispatch
+            )
     print(json.dumps(summary, sort_keys=True))
     return 0 if summary["ok"] else 1
 

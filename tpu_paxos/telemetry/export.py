@@ -13,7 +13,13 @@ JSON (the ``chrome://tracing`` / https://ui.perfetto.dev format,
   proposer node's track at the recorder's first-takeover round);
 - **counter tracks** (cumulative decided instances over rounds), plus
   the full flight-recorder summary attached as the ``telemetry``
-  block of ``otherData``.
+  block of ``otherData``;
+- **windowed counter tracks** when the summary carries the
+  time-resolved plane (``"windows"`` block, telemetry/recorder
+  ``windows_to_dict``): per-bucket latency p50/p99, observed drop
+  rate, decisions per window, and stall depth rendered as counter
+  series on the SAME timeline as the episode spans — so a latency
+  blowout reads directly against the fault that caused it.
 
 One simulated round maps to one trace millisecond (``ROUND_US``).
 
@@ -42,13 +48,17 @@ import numpy as np
 #: numbers read directly off the Perfetto grid in milliseconds).
 ROUND_US = 1000
 
-#: Cap on per-instance decision instants (a million-instance run must
-#: not emit a million events; the counter track still shows the
-#: totals).  Dropped events are counted in otherData.
+#: Default cap on per-instance decision instants (a million-instance
+#: run must not emit a million events; the counter track still shows
+#: the totals).  Dropped events are counted in otherData AND called
+#: out by a visible annotation instant on the decision track at the
+#: cap point; ``python -m tpu_paxos trace --max-decision-events N``
+#: overrides per render.
 MAX_DECISION_EVENTS = 1024
 
 _NET_TRACK = "network"
 _DECISION_TRACK = "decisions"
+_TELEMETRY_TRACK = "telemetry"
 
 
 def _ev(ph, name, pid, tid=0, ts=0, **kw):
@@ -108,22 +118,66 @@ def _episode_events(schedule, n_nodes: int, net_pid: int) -> list:
     return events
 
 
-def chrome_trace(cfg, result, summary_dict=None, label="tpu-paxos") -> dict:
+def _window_counter_events(windows: dict, tele_pid: int) -> list:
+    """The windowed series as Perfetto counter tracks: one ``C``
+    event per (series, bucket) at the bucket's START round, so the
+    curves step exactly on the window grid the recorder accumulated
+    on and line up with the episode duration bars.  Empty-bucket
+    latency quantiles (-1) are skipped rather than rendered (a -1
+    dip would read as a latency collapse)."""
+    events = []
+    wr = int(windows["window_rounds"])
+    n = int(windows["n_windows"])
+
+    def counter(name, series, skip_neg=False):
+        for w in range(n):
+            v = series[w]
+            if skip_neg and v < 0:
+                continue
+            events.append(_ev(
+                "C", name, tele_pid, ts=w * wr * ROUND_US,
+                args={name: v},
+            ))
+
+    counter("latency p50 (rounds)", windows["latency_p50"],
+            skip_neg=True)
+    counter("latency p99 (rounds)", windows["latency_p99"],
+            skip_neg=True)
+    counter("drop rate (/1e4)", windows["drop_rate_observed"])
+    counter("decided / window", windows["decided"])
+    counter("stall depth", windows["stall_max"])
+    counter("takeovers / window", windows["takeovers"])
+    return events
+
+
+def chrome_trace(
+    cfg, result, summary_dict=None, label="tpu-paxos",
+    max_decision_events: int = MAX_DECISION_EVENTS,
+) -> dict:
     """Build the Chrome-trace dict for one run.
 
     ``result`` is a ``core/sim.SimResult``; ``summary_dict`` is the
     flight recorder's ``summary_to_dict`` output (or None for
-    recorder-free replays, e.g. sharded artifacts)."""
+    recorder-free replays, e.g. sharded artifacts) — when it carries
+    the windowed ``"windows"`` block, the series render as counter
+    tracks on a dedicated telemetry process.  ``max_decision_events``
+    caps the per-instance decision instants; hitting the cap emits a
+    visible "N decision instants dropped" annotation at the cap
+    point instead of truncating silently."""
     from tpu_paxos.core import values as val
 
     a = cfg.n_nodes
-    net_pid, dec_pid = a, a + 1
+    net_pid, dec_pid, tele_pid = a, a + 1, a + 2
+    windows = (summary_dict or {}).get("windows")
     events = []
     for node in range(a):
         role = " (proposer)" if node in cfg.proposers else ""
         _meta(events, node, f"node {node}{role}")
     _meta(events, net_pid, _NET_TRACK)
     _meta(events, dec_pid, _DECISION_TRACK)
+    if windows is not None:
+        _meta(events, tele_pid, _TELEMETRY_TRACK)
+        events += _window_counter_events(windows, tele_pid)
     events += _episode_events(cfg.faults.schedule, a, net_pid)
 
     # decisions: instants on the decision track + a cumulative counter
@@ -132,7 +186,10 @@ def chrome_trace(cfg, result, summary_dict=None, label="tpu-paxos") -> dict:
     chosen_ballot = np.asarray(result.chosen_ballot)
     decided = np.flatnonzero(chosen_vid != int(val.NONE))
     order = decided[np.argsort(chosen_round[decided], kind="stable")]
-    for k, i in enumerate(order[:MAX_DECISION_EVENTS]):
+    # a negative cap would slice from the tail AND over-count the
+    # dropped events; clamp — 0 legitimately means "counters only"
+    cap = max(0, int(max_decision_events))
+    for k, i in enumerate(order[:cap]):
         events.append(_ev(
             "i", f"decide [{int(i)}]", dec_pid,
             ts=int(chosen_round[i]) * ROUND_US, s="g",
@@ -142,6 +199,17 @@ def chrome_trace(cfg, result, summary_dict=None, label="tpu-paxos") -> dict:
                 "ballot": int(chosen_ballot[i]),
                 "round": int(chosen_round[i]),
             },
+        ))
+    n_dropped = max(0, int(len(decided)) - cap)
+    if n_dropped:
+        # the cap must be VISIBLE in the trace itself, not only in
+        # otherData: an instant at the last rendered decision's round
+        # says exactly how much of the tail is missing
+        last_ts = int(chosen_round[order[cap - 1]]) if cap else 0
+        events.append(_ev(
+            "i", f"{n_dropped} decision instants dropped (cap {cap})",
+            dec_pid, ts=last_ts * ROUND_US, s="g",
+            args={"dropped": n_dropped, "cap": cap},
         ))
     rounds, counts = np.unique(chosen_round[decided], return_counts=True)
     cum = 0
@@ -168,9 +236,8 @@ def chrome_trace(cfg, result, summary_dict=None, label="tpu-paxos") -> dict:
         "done": bool(result.done),
         "n_nodes": a,
         "decided": int(len(decided)),
-        "decision_events_dropped": max(
-            0, int(len(decided)) - MAX_DECISION_EVENTS
-        ),
+        "decision_events_dropped": n_dropped,
+        "decision_events_cap": cap,
         "round_us": ROUND_US,
     }
     if summary_dict is not None:
@@ -182,8 +249,11 @@ def chrome_trace(cfg, result, summary_dict=None, label="tpu-paxos") -> dict:
     }
 
 
-def trace_artifact(path: str) -> dict:
-    """Re-execute a repro artifact with the flight recorder armed and
+def trace_artifact(
+    path: str, max_decision_events: int = MAX_DECISION_EVENTS
+) -> dict:
+    """Re-execute a repro artifact with the flight recorder armed
+    (windowed plane included — the counter tracks come from it) and
     render the Chrome trace.  Telemetry is recomputed at replay —
     never read from (or written to) the artifact, whose schema stays
     closed."""
@@ -193,16 +263,21 @@ def trace_artifact(path: str) -> dict:
 
     case, art = shr.load_artifact(path)
     if case.engine == "sim":
-        result, summ = simm.run_with_telemetry(
+        result, summ, wsum = simm.run_with_telemetry(
             case.cfg, case.workload, case.gates
         )
-        summary_dict = telem.summary_to_dict(summ)
+        summary_dict = telem.summary_to_dict(
+            summ, wsum, telem.WINDOW_ROUNDS
+        )
     else:
         # sharded replays are recorder-free (build_engine rejects
         # telemetry with axis_name); episodes + decisions still render
         result, _ = shr.run_case(case)
         summary_dict = None
-    trace = chrome_trace(case.cfg, result, summary_dict, label=path)
+    trace = chrome_trace(
+        case.cfg, result, summary_dict, label=path,
+        max_decision_events=max_decision_events,
+    )
     trace["otherData"]["artifact"] = path
     trace["otherData"]["recorded_violation"] = art["violation"]
     trace["otherData"]["engine"] = case.engine
@@ -229,6 +304,11 @@ def main(argv=None) -> int:
                     "writing a file")
     ap.add_argument("--backend", choices=("tpu", "cpu", "auto"),
                     default="auto")
+    ap.add_argument("--max-decision-events", type=int,
+                    default=MAX_DECISION_EVENTS,
+                    help="cap on per-instance decision instants; a "
+                    "hit cap renders a visible 'N dropped' "
+                    "annotation in the trace")
     ap.add_argument("--json", action="store_true",
                     help="emit a JSON status line instead of the "
                     "verdict line")
@@ -264,7 +344,10 @@ def main(argv=None) -> int:
 
     logger = logm.get_logger("trace", _level(args))
     try:
-        trace = trace_artifact(args.artifact)
+        trace = trace_artifact(
+            args.artifact,
+            max_decision_events=args.max_decision_events,
+        )
     except ArtifactSchemaError as e:
         logger.error("%s", e)
         _emit(args, {

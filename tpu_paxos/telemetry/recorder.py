@@ -28,6 +28,16 @@ Three field families:
   commit-ladder stall depth, max duel depth (ballot count), first
   takeover round per proposer.
 
+A fourth, TIME-RESOLVED plane rides alongside when the engine is
+built with ``window_rounds``: :class:`TelemetryWindows` buckets the
+fault-layer counters, stall depth, and takeover/restart events by
+virtual round into ``NUM_WINDOWS`` fixed-shape ``[W]`` rings (last
+bucket = overflow), and :func:`summarize_windows` derives per-bucket
+commit counts and latency-histogram deltas from the decision metrics
+at the epilogue — so "when did p99 blow out relative to the fault"
+is answerable without storing anything per-round.  Same neutrality
+contract; ``[lanes, W]`` under the fleet vmap.
+
 Neutrality contract: the recorder is READ-ONLY — it consumes no PRNG
 streams and never feeds back into ``SimState``, so a telemetry-armed
 engine is decision-log-identical to the plain one (sha256 parity
@@ -63,6 +73,18 @@ MSG_NAMES = (
 LAT_EDGES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 NUM_LAT_BUCKETS = len(LAT_EDGES) + 1
 
+#: Windowed time-series plane: NUM_WINDOWS fixed-shape buckets over
+#: the virtual clock, each ``window_rounds`` rounds wide; the last
+#: bucket is the overflow (everything at and past round
+#: ``(NUM_WINDOWS - 1) * window_rounds``).  The bucket COUNT is a
+#: module constant so every ``[W]`` series shares one shape; the
+#: bucket WIDTH is a trace-time build parameter (``window_rounds``)
+#: so each driver picks its own time resolution — the serve driver
+#: aligns buckets with its admission windows, the fleet and the
+#: single-run engine default to :data:`WINDOW_ROUNDS`.
+NUM_WINDOWS = 16
+WINDOW_ROUNDS = 16
+
 
 class Telemetry(NamedTuple):
     """Per-round accumulators carried through the traced loop (one
@@ -85,6 +107,43 @@ class Telemetry(NamedTuple):
     admit_round: np.ndarray  # [I] int32 first round in an accept batch
     takeover_round: np.ndarray  # [P] int32 first takeover round (NONE)
     stall_max: np.ndarray  # int32 max stall counter ever observed
+
+
+class TelemetryWindows(NamedTuple):
+    """Per-round windowed accumulators (one lane; ``[lanes, W]``
+    under the fleet vmap): the fields that CANNOT be recovered from
+    the final state — fault-layer counters read from ephemeral copy
+    plans, stall depth, and event counts — bucketed by the virtual
+    round at accumulation time.  Same neutrality contract as
+    :class:`Telemetry`: read-only, no PRNG, no feedback into state.
+    Decision-time series (per-bucket commit counts, latency-histogram
+    deltas) are derived at the epilogue by :func:`summarize_windows`
+    from ``chosen_round`` — they need no per-round accumulation."""
+
+    offered: np.ndarray  # [W] int32 edges offered (all message types)
+    dropped: np.ndarray  # [W] int32 copies dropped
+    duped: np.ndarray  # [W] int32 duplicate copies spawned
+    delayed: np.ndarray  # [W] int32 surviving copies with delay > 0
+    stall_max: np.ndarray  # [W] int32 max stall depth seen in bucket
+    takeovers: np.ndarray  # [W] int32 commit-takeover adoptions
+    restarts: np.ndarray  # [W] int32 proposer ballot restarts
+
+
+class WindowSummary(NamedTuple):
+    """The windowed series that crosses to host (``[lanes, W, ...]``
+    under the fleet vmap): the accumulated rings plus the
+    decision-time series derived on device by
+    :func:`summarize_windows`."""
+
+    offered: np.ndarray  # [W] int32
+    dropped: np.ndarray  # [W] int32
+    duped: np.ndarray  # [W] int32
+    delayed: np.ndarray  # [W] int32
+    stall_max: np.ndarray  # [W] int32
+    takeovers: np.ndarray  # [W] int32
+    restarts: np.ndarray  # [W] int32
+    decided: np.ndarray  # [W] int32 decisions per bucket
+    lat_hist: np.ndarray  # [W, NUM_LAT_BUCKETS] int32 latency deltas
 
 
 class TelemetrySummary(NamedTuple):
@@ -130,6 +189,79 @@ def init_telemetry(n_instances: int, n_proposers: int) -> Telemetry:
         admit_round=jnp.full((n_instances,), val.NONE, jnp.int32),
         takeover_round=jnp.full((n_proposers,), val.NONE, jnp.int32),
         stall_max=jnp.int32(0),
+    )
+
+
+def init_windows() -> TelemetryWindows:
+    """Zeroed windowed accumulators for one lane.  One DISTINCT
+    buffer per field: the serve driver donates the whole loop state,
+    and donating one buffer through two tree leaves is an XLA
+    error."""
+    import jax.numpy as jnp
+
+    def z():
+        return jnp.zeros((NUM_WINDOWS,), jnp.int32)
+
+    return TelemetryWindows(
+        offered=z(), dropped=z(), duped=z(), delayed=z(),
+        stall_max=z(), takeovers=z(), restarts=z(),
+    )
+
+
+def window_bucket(t, window_rounds: int):
+    """Bucket index of virtual round ``t``: ``t // window_rounds``,
+    clamped into the overflow bucket.  A round landing exactly on a
+    bucket boundary opens the NEXT bucket (round ``window_rounds``
+    is the first round of bucket 1)."""
+    import jax.numpy as jnp
+
+    return jnp.minimum(
+        jnp.asarray(t, jnp.int32) // jnp.int32(window_rounds),
+        jnp.int32(NUM_WINDOWS - 1),
+    )
+
+
+def summarize_windows(
+    wins: TelemetryWindows,
+    admit_round,
+    chosen_vid,
+    chosen_round,
+    window_rounds: int,
+) -> WindowSummary:
+    """Close one lane's windowed series, on device: the accumulated
+    rings pass through; per-bucket commit counts and latency-histogram
+    deltas are derived here from the decision metrics (each decided
+    instance lands in the bucket of its DECISION round; its latency —
+    decision minus admission, ingest-stamped on the serve path — bins
+    against ``LAT_EDGES`` exactly like the run-total histogram, so the
+    windowed histograms sum to the cumulative one bucket-for-bucket).
+    No-op fills count as decisions but never enter the latency series
+    (their admission stamp is NONE), matching :func:`summarize`."""
+    import jax.numpy as jnp
+
+    decided_mask = chosen_vid != val.NONE  # [I]
+    lat_ok = decided_mask & (admit_round != val.NONE)
+    lat = jnp.where(lat_ok, jnp.maximum(chosen_round - admit_round, 0), 0)
+    wb = window_bucket(jnp.where(decided_mask, chosen_round, 0),
+                       window_rounds)  # [I]
+    decided = jnp.zeros((NUM_WINDOWS,), jnp.int32).at[wb].add(
+        decided_mask.astype(jnp.int32)
+    )
+    edges = jnp.asarray(LAT_EDGES, jnp.int32)
+    lb = jnp.sum(lat[:, None] > edges[None, :], axis=1)  # [I] in 0..B-1
+    lat_hist = jnp.zeros(
+        (NUM_WINDOWS, NUM_LAT_BUCKETS), jnp.int32
+    ).at[wb, lb].add(lat_ok.astype(jnp.int32))
+    return WindowSummary(
+        offered=wins.offered,
+        dropped=wins.dropped,
+        duped=wins.duped,
+        delayed=wins.delayed,
+        stall_max=wins.stall_max,
+        takeovers=wins.takeovers,
+        restarts=wins.restarts,
+        decided=decided,
+        lat_hist=lat_hist,
     )
 
 
@@ -238,10 +370,50 @@ def latency_quantile(hist: np.ndarray, q: float, lat_max: int) -> int:
     return int(lat_max)
 
 
-def summary_to_dict(s: TelemetrySummary) -> dict:
+def windows_to_dict(
+    w: WindowSummary, window_rounds: int, lat_max: int
+) -> dict:
+    """One lane's windowed series as a JSON-ready dict of [W] lists
+    (the time-resolved twin of :func:`summary_to_dict`).  Per-bucket
+    latency quantiles are bucket-edge estimates clamped to the RUN's
+    observed max (``lat_max``); empty buckets report -1."""
+    hist = np.asarray(w.lat_hist)  # [W, B]
+    return {
+        "window_rounds": int(window_rounds),
+        "n_windows": int(hist.shape[0]),
+        "decided": np.asarray(w.decided).tolist(),
+        "offered": np.asarray(w.offered).tolist(),
+        "dropped": np.asarray(w.dropped).tolist(),
+        "duped": np.asarray(w.duped).tolist(),
+        "delayed": np.asarray(w.delayed).tolist(),
+        "drop_rate_observed": [
+            round(1e4 * float(d) / float(o), 1) if int(o) else 0.0
+            for d, o in zip(np.asarray(w.dropped), np.asarray(w.offered))
+        ],
+        "stall_max": np.asarray(w.stall_max).tolist(),
+        "takeovers": np.asarray(w.takeovers).tolist(),
+        "restarts": np.asarray(w.restarts).tolist(),
+        "latency_p50": [
+            latency_quantile(row, 0.50, lat_max) for row in hist
+        ],
+        "latency_p99": [
+            latency_quantile(row, 0.99, lat_max) for row in hist
+        ],
+        "lat_hist": hist.tolist(),  # [W, B] — the SLO monitor's input
+        "latency_edges": list(LAT_EDGES),
+    }
+
+
+def summary_to_dict(
+    s: TelemetrySummary,
+    windows: WindowSummary | None = None,
+    window_rounds: int = WINDOW_ROUNDS,
+) -> dict:
     """One lane's summary as a JSON-ready dict (plain ints/lists),
-    with derived p50/p99 latency estimates.  Under the fleet vmap
-    index the summary first (``jax.tree.map(lambda x: x[i], s)``)."""
+    with derived p50/p99 latency estimates; ``windows`` (one lane's
+    :class:`WindowSummary`) adds the time-resolved ``"windows"``
+    block.  Under the fleet vmap index the summary first
+    (``jax.tree.map(lambda x: x[i], s)``)."""
     hist = np.asarray(s.lat_hist)
     lat_max = int(s.lat_max)
     offered = np.asarray(s.offered)
@@ -277,6 +449,10 @@ def summary_to_dict(s: TelemetrySummary) -> dict:
         "takeover_round": np.asarray(s.takeover_round).tolist(),
         "rounds": int(s.rounds),
         "quiescent": bool(s.quiescent),
+        **(
+            {"windows": windows_to_dict(windows, window_rounds, lat_max)}
+            if windows is not None else {}
+        ),
     }
 
 
@@ -292,17 +468,66 @@ def margins_vector(s: TelemetrySummary) -> dict:
     }
 
 
-def reduce_lanes(s: TelemetrySummary) -> dict:
+def reduce_lanes_windows(
+    w: WindowSummary, window_rounds: int, lat_max: int
+) -> dict:
+    """Across-lane aggregate of a ``[lanes, W]``-leading window stack:
+    per-bucket sums for the count series, per-bucket across-lane MAX
+    for stall depth (the deepest any lane stalled in that bucket),
+    and per-bucket latency quantiles over the lane-summed histogram
+    deltas.  The stress sweep's per-mix windowed column and the
+    search's windowed margin series both derive from this dict."""
+    summed = WindowSummary(
+        offered=np.asarray(w.offered).sum(axis=0),
+        dropped=np.asarray(w.dropped).sum(axis=0),
+        duped=np.asarray(w.duped).sum(axis=0),
+        delayed=np.asarray(w.delayed).sum(axis=0),
+        stall_max=np.asarray(w.stall_max).max(axis=0),
+        takeovers=np.asarray(w.takeovers).sum(axis=0),
+        restarts=np.asarray(w.restarts).sum(axis=0),
+        decided=np.asarray(w.decided).sum(axis=0),
+        lat_hist=np.asarray(w.lat_hist).sum(axis=0),
+    )
+    return windows_to_dict(summed, window_rounds, lat_max)
+
+
+def stall_margin_series(w: WindowSummary, patience: int) -> list:
+    """The windowed near-miss margin series (ROADMAP item 2's
+    trajectory fitness signal): per bucket, the MINIMUM over lanes of
+    ``patience - stall_max`` — how many idle rounds of headroom the
+    closest lane had left before its commit-ladder stall tripped the
+    takeover/restart threshold in that bucket.  ``patience`` is the
+    engine's stall threshold (``core/sim.IDLE_RESTART_ROUNDS``); a
+    margin <= 0 means some lane actually hit it there.  Works on a
+    ``[lanes, W]`` stack or a single ``[W]`` lane."""
+    stall = np.asarray(w.stall_max)
+    if stall.ndim > 1:
+        stall = stall.max(axis=0)
+    return (int(patience) - stall).astype(np.int64).tolist()
+
+
+def reduce_lanes(
+    s: TelemetrySummary,
+    windows: WindowSummary | None = None,
+    window_rounds: int = WINDOW_ROUNDS,
+) -> dict:
     """Across-lane aggregate of a ``[lanes]``-leading summary stack —
     the ONE owner of the stack-reduction semantics (never-quiesced
     ``-1`` heal gaps excluded from the min; latency quantiles over
-    the summed histogram).  The stress sweep's per-mix block and the
-    search's per-generation margins both derive from this dict."""
+    the summed histogram).  ``windows`` (a ``[lanes, W]`` stack) adds
+    the time-resolved ``"windows"`` block.  The stress sweep's
+    per-mix block and the search's per-generation margins both derive
+    from this dict."""
     gaps = np.asarray(s.heal_gap)
     quiesced = gaps[gaps >= 0]
     hist = np.asarray(s.lat_hist).sum(axis=0)
     lat_max = int(np.asarray(s.lat_max).max())
+    win_blk = (
+        {"windows": reduce_lanes_windows(windows, window_rounds, lat_max)}
+        if windows is not None else {}
+    )
     return {
+        **win_blk,
         "offered": int(np.asarray(s.offered).sum()),
         "dropped": int(np.asarray(s.dropped).sum()),
         "duped": int(np.asarray(s.duped).sum()),
