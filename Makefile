@@ -33,14 +33,22 @@ lint-json:
 # normalized-HLO goldens for the hot kernels, per-primitive
 # instruction budgets + memory ceilings, and the donation/aliasing
 # checker.  Traces on CPU — ops counts are backend-independent; the
-# HLO pins are backend-gated.  Re-pin after intentional program
-# growth: TPU_PAXOS_OP_BUDGET_PIN=1 make audit (jaxpr tier) /
+# HLO pins are backend-gated AND compiled under the repo's canonical
+# CPU environment: the 8-virtual-device mesh tests/conftest.py
+# provisions (XLA's CPU backend partitions fusions differently per
+# device count, so the goldens only reproduce under the same count —
+# tests/test_hlo_audit.py enforces the committed pins from inside
+# that mesh).  Re-pin after intentional program growth:
+# TPU_PAXOS_OP_BUDGET_PIN=1 make audit (jaxpr tier) /
 # TPU_PAXOS_HLO_PIN=1 make audit (HLO goldens + budget).
+AUDIT_ENV = JAX_PLATFORMS=cpu \
+	XLA_FLAGS="$(XLA_FLAGS) --xla_force_host_platform_device_count=8"
+
 audit:
-	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --hlo
+	$(AUDIT_ENV) $(PY) -m tpu_paxos audit --hlo
 
 audit-json:
-	JAX_PLATFORMS=cpu $(PY) -m tpu_paxos audit --hlo --json
+	$(AUDIT_ENV) $(PY) -m tpu_paxos audit --hlo --json
 
 # Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
 # static analyzers first (cheapest signal), then the quick-scope model

@@ -843,78 +843,130 @@ def bench_serve_record() -> dict:
     return record
 
 
+def _member_record(host_runs, dev_runs, state_bytes, config) -> dict:
+    """Record-or-error for the membership host-vs-device timing pairs
+    — pure, so tests/test_bench_guards.py drives it with synthetic
+    runs.  ``host_runs[k]`` / ``dev_runs[k]`` are ``(wall_s, rounds,
+    decision_log_sha256)`` for the SAME (churn table, seed), so three
+    guards apply: (a) the drivers must be decision-log-identical pair
+    for pair — a sha mismatch means the ChurnTable interpreters
+    diverged and the speedup claim is meaningless, so the record is
+    withheld; (b) every engine round streams the [I]-sized state at
+    least once, so ``state_bytes * rounds`` roofline-bounds the
+    traffic EITHER timing implies; (c) the published value is the
+    SLOWEST device run's rounds/sec — conservative for re-run
+    timing."""
+    raw_h = [round(w, 4) for w, _r, _s in host_runs]
+    raw_d = [round(w, 4) for w, _r, _s in dev_runs]
+    for k, ((_hw, _hr, hs), (_dw, _dr, ds)) in enumerate(
+        zip(host_runs, dev_runs)
+    ):
+        if hs != ds:
+            return {
+                "engine": "member",
+                "error": (
+                    f"decision-log sha256 mismatch between drivers on "
+                    f"run {k} ({hs[:16]}... vs {ds[:16]}...); the "
+                    "host-stepped and device-resident drivers must "
+                    "run identical trajectories — speedup withheld"
+                ),
+                "raw_timings_s": raw_d,
+                "host_raw_s": raw_h,
+                "config": config,
+            }
+    for label, runs in (("host-stepped", host_runs),
+                        ("device-resident", dev_runs)):
+        for w, r, _s in runs:
+            refusal = _implausible(state_bytes * max(r, 1), w)
+            if refusal is not None:
+                return {
+                    "engine": "member",
+                    "error": f"{label} timing: {refusal}",
+                    "raw_timings_s": raw_d,
+                    "host_raw_s": raw_h,
+                    "config": config,
+                }
+    rate_d = min(r / w for w, r, _s in dev_runs)
+    rate_h = min(r / w for w, r, _s in host_runs)
+    return {
+        "engine": "member",
+        "metric": "member_rounds_per_sec",
+        "value": round(rate_d, 1),
+        "unit": "rounds/sec",
+        "rounds": dev_runs[0][1],
+        "raw_timings_s": raw_d,
+        "host_stepped": {
+            # the same churn table through the legacy per-round-sync
+            # driver (ChurnEngine.run_host) — the cost model every
+            # record before PR 12 published
+            "member_rounds_per_sec": round(rate_h, 1),
+            "raw_timings_s": raw_h,
+            "speedup": round(rate_d / max(rate_h, 1e-9), 2),
+        },
+        "parity": {
+            "decision_log_sha256": dev_runs[0][2],
+            "drivers": "host-stepped == device-resident, per seed",
+        },
+        "config": config,
+    }
+
+
 def bench_member_record() -> dict:
     """Secondary record: the MEMBERSHIP engine under the BASELINE
-    config-5 churn shape at its literal size (grow the acceptor set
-    1->7 with values in flight, shrink to 5, Applied sequencing) over
-    a sizeable log.  The engine is host-stepped (the reference's
-    member/main.cpp driver model), so the metric is engine rounds/sec
-    including the host's per-round predicate reads — the honest cost
-    model for this engine.  Timing: fresh-state re-runs on the same
-    compiled round (recompiling per seed would dwarf the scenario),
-    slowest-of-2 reported, roofline-guarded like every other record.
+    config-5 churn shape (grow the acceptor set 1->7 with values in
+    flight, shrink to 5, Applied sequencing) over a sizeable log —
+    HOST-STEPPED vs DEVICE-RESIDENT.  The scenario is a runtime
+    ``ChurnTable`` (membership/churn_table.py) driven two ways on the
+    same engine build: ``ChurnEngine.run_host`` re-creates the legacy
+    per-round host loop (injection + termination decided from
+    per-round np reads — the cost model the pre-PR-12 records
+    published), ``ChurnEngine.run`` is one ``lax.while_loop``
+    dispatch.  Decision-log sha256 parity between the two is enforced
+    per seed (``_member_record``); the headline is the device
+    driver's rounds/sec.  Timing: fresh seeds per timed run on the
+    one compiled program, slowest run reported, roofline-guarded.
     Default size keeps the record inside the bench budget; set
     TPU_PAXOS_BENCH_MEMBER_INSTANCES=1048576 for the BASELINE
     config-5 literal size (tests/test_membership.py runs it on every
     suite pass)."""
+    import hashlib
+
+    from tpu_paxos.membership import churn_table as ctm
     from tpu_paxos.membership import engine as meng
 
     i = int(os.environ.get("TPU_PAXOS_BENCH_MEMBER_INSTANCES", 1 << 17))
     n = 7
+    churn = ctm.grow_shrink_schedule(7, 5, values_per_step=1)
+    eng = meng.ChurnEngine(n, i, churn=churn, max_rounds=4000)
+    state_bytes = _state_nbytes(meng._init(n, i, eng.c))
+    warm = eng.run(seed=5)  # compile + warm both paths
+    if not warm.done:
+        raise RuntimeError("membership churn scenario did not complete")
+    eng.run_host(seed=5)
 
-    def scenario(ms):
-        vid = 100
-        for tgt in range(1, 7):
-            ms.propose(0, vid)
-            vid += 1
-            cv = ms.add_acceptor(tgt)
-            if not ms.run_until(lambda: ms.applied(cv), max_rounds=4000):
-                raise RuntimeError(f"churn add {tgt} stalled")
-        for tgt in (6, 5):
-            cv = ms.del_acceptor(tgt)
-            if not ms.run_until(lambda: ms.applied(cv), max_rounds=4000):
-                raise RuntimeError(f"churn del {tgt} stalled")
-        if not ms.run_until(
-            lambda: all(ms.chosen(v) for v in range(100, vid)),
-            max_rounds=4000,
-        ):
-            raise RuntimeError("values unchosen after churn")
-        return int(ms.state.t)
+    def sha(res) -> str:
+        return hashlib.sha256(res.decision_log().encode()).hexdigest()
 
-    ms = meng.MemberSim(n_nodes=n, n_instances=i, seed=5)
-    state_bytes = _state_nbytes(ms.state)
-    scenario(ms)  # compile + warm
-    dts, rounds = [], 0
-    for _ in range(2):
-        ms.state = meng._init(n, i, ms.c)
-        ms.injections.clear()  # fresh run: keep the record/replay log
-        # consistent with the state it describes
+    host_runs, dev_runs = [], []
+    for seed in (6, 7):  # fresh seeds: timed calls differ in content
         t0 = time.perf_counter()
-        rounds = scenario(ms)
-        dts.append(time.perf_counter() - t0)
-    dt = sorted(dts)[-1]  # slowest of 2: conservative for re-run timing
+        r = eng.run(seed=seed)
+        dev_runs.append((time.perf_counter() - t0, r.rounds, sha(r)))
+        t0 = time.perf_counter()
+        rh = eng.run_host(seed=seed)
+        host_runs.append((time.perf_counter() - t0, rh.rounds, sha(rh)))
+        if not r.done:
+            raise RuntimeError(f"device churn run (seed {seed}) stalled")
     config = {
         "n_nodes": n,
         "n_instances": i,
-        "churn": "grow 1->7, shrink to 5, 6 values in flight",
+        "churn": "grow 1->7, shrink to 5, 6 values in flight "
+                 f"(ChurnTable, {len(churn.events)} events)",
+        "churn_events": len(churn.events),
         "devices": 1,
         "platform": jax.devices()[0].platform,
     }
-    raw = [round(x, 4) for x in sorted(dts)]
-    refusal = _implausible(state_bytes * rounds, dt)
-    if refusal is not None:
-        return {"engine": "member", "error": refusal, "raw_timings_s": raw,
-                "config": config}
-    return {
-        "engine": "member",
-        "metric": "member_rounds_per_sec",
-        "value": round(rounds / dt, 1),
-        "unit": "rounds/sec",
-        "rounds": rounds,
-        "wall_s": round(dt, 3),
-        "raw_timings_s": raw,
-        "config": config,
-    }
+    return _member_record(host_runs, dev_runs, state_bytes, config)
 
 
 def bench_sharded_child() -> list[dict]:

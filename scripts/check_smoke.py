@@ -76,9 +76,25 @@ def smoke_member() -> None:
     print(f"  member: value chosen + membership change applied, t={int(ms.state.t)}")
 
 
+def smoke_churn() -> None:
+    # the device-resident churn driver, op by op: the injection
+    # gate's index clamps, the guarded pending-ring scatter, and the
+    # run-complete cond all materialize eagerly here
+    from tpu_paxos.membership import churn_table as ctm
+    from tpu_paxos.membership.engine import ChurnEngine
+
+    eng = ChurnEngine(
+        3, 8, churn=ctm.grow_shrink_schedule(3, 2), max_rounds=120,
+    )
+    res = eng.run(seed=0)
+    assert res.done, f"churn smoke stalled at t={res.rounds}"
+    print(f"  churn: {res.injected} events driven on device, t={res.rounds}")
+
+
 if __name__ == "__main__":
     print("check: un-jitted smoke (JAX_DISABLE_JIT=1)")
     smoke_sim()
     smoke_fast()
     smoke_member()
+    smoke_churn()
     print("check: OK")

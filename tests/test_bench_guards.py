@@ -165,6 +165,51 @@ def test_serve_record_withholds_on_p99_mismatch():
     assert "value" not in rec
 
 
+def test_member_record_publishes_with_parity_and_host_block():
+    """The membership host-vs-device record: per-seed sha parity and
+    plausible timings publish the DEVICE rate (slowest run) with the
+    host-stepped figure and speedup alongside."""
+    host = [(2.0, 30, "aa"), (2.1, 30, "bb")]
+    dev = [(1.0, 30, "aa"), (0.9, 30, "bb")]
+    rec = bench._member_record(host, dev, 1 << 20, {"devices": 1})
+    assert rec["metric"] == "member_rounds_per_sec"
+    assert rec["value"] == pytest.approx(30 / 1.0, abs=0.1)
+    assert rec["host_stepped"]["member_rounds_per_sec"] == pytest.approx(
+        30 / 2.1, abs=0.1
+    )
+    assert rec["host_stepped"]["speedup"] == pytest.approx(
+        (30 / 1.0) / (30 / 2.1), abs=0.01
+    )
+    assert rec["parity"]["decision_log_sha256"] == "aa"
+
+
+def test_member_record_withholds_on_sha_mismatch():
+    """A decision-log divergence between the host-stepped and
+    device-resident drivers means the ChurnTable interpreters split —
+    the speedup claim is withheld, never published with asterisks."""
+    host = [(2.0, 30, "aa"), (2.1, 30, "bb")]
+    dev = [(1.0, 30, "aa"), (0.9, 30, "XX")]
+    rec = bench._member_record(host, dev, 1 << 20, {"devices": 1})
+    assert "error" in rec and "sha256 mismatch" in rec["error"]
+    assert "run 1" in rec["error"]
+    assert "value" not in rec and "host_stepped" not in rec
+    assert rec["raw_timings_s"] and rec["host_raw_s"]
+
+
+def test_member_record_withholds_implausible_timing():
+    """A lying timing on EITHER driver (1 GiB of state x 30 rounds in
+    a microsecond) withholds the record — the roofline guard applies
+    to the baseline side too, or the speedup could be inflated by an
+    artificially slow host figure's plausible-looking twin."""
+    for host, dev in (
+        ([(1e-6, 30, "aa")], [(1.0, 30, "aa")]),
+        ([(2.0, 30, "aa")], [(1e-6, 30, "aa")]),
+    ):
+        rec = bench._member_record(host, dev, 1 << 30, {"devices": 1})
+        assert "error" in rec and "roofline" in rec["error"]
+        assert "value" not in rec
+
+
 def test_guard_headline_publishes_measured_rate():
     # 1 GiB state, 10 ms median: plausible — median rate published
     rate, upper, note = bench._guard_headline(

@@ -1,0 +1,238 @@
+"""ChurnTable + device-resident membership driver (PR 12).
+
+The contracts, in dependency order: the churn-schedule data model
+round-trips and validates; the compiled-constant and runtime-table
+builds of the membership round are STATE-IDENTICAL per round (the
+ScheduleTable parity discipline, crash masks included); the
+host-stepped and device-resident drivers of the same ChurnTable are
+decision-log sha256-IDENTICAL on a churn+crash+pause mix; the device
+scenario itself converges with prefix-consistent logs; and the
+deterministic ``crash`` episode kind — which PR 8 made this engine
+reject — now fail-stops exactly like the host ``crash()`` injector.
+"""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import values as val
+from tpu_paxos.fleet import schedule_table as stm
+from tpu_paxos.harness import validate
+from tpu_paxos.membership import churn_table as ctm
+from tpu_paxos.membership import engine as meng
+from tpu_paxos.utils import prng
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+# ---------------- data model ----------------
+
+def test_churn_event_validation():
+    with pytest.raises(ValueError, match="vid"):
+        ctm.ChurnEvent(vid=-1)
+    with pytest.raises(ValueError, match="wait"):
+        ctm.ChurnEvent(vid=1, wait=7)
+    with pytest.raises(ValueError, match="t0"):
+        ctm.ChurnEvent(vid=1, t0=-2)
+    with pytest.raises(ValueError, match="first event"):
+        ctm.ChurnSchedule((ctm.ChurnEvent(vid=1, wait=ctm.WAIT_CHOSEN),))
+    with pytest.raises(ValueError, match="distinct"):
+        ctm.ChurnSchedule((
+            ctm.ChurnEvent(vid=1),
+            ctm.ChurnEvent(vid=1, wait=ctm.WAIT_CHOSEN),
+        ))
+
+
+def test_churn_schedule_json_roundtrip():
+    sched = ctm.grow_shrink_schedule(4, 2, values_per_step=2)
+    again = ctm.ChurnSchedule.from_dict(sched.to_dict())
+    assert again == sched
+    assert ctm.ChurnSchedule.from_dict({"events": []}) == ctm.ChurnSchedule()
+
+
+def test_encode_churn_padding_and_bounds():
+    sched = ctm.ChurnSchedule((
+        ctm.ChurnEvent(vid=5, via=1, t0=3),
+        ctm.ChurnEvent(vid=6, wait=ctm.WAIT_APPLIED),
+    ))
+    tab = ctm.encode_churn(sched, 3, max_events=4)
+    assert tab.vid.tolist() == [5, 6, int(val.NONE), int(val.NONE)]
+    assert tab.via.tolist() == [1, 0, 0, 0]
+    assert int(tab.n_events) == 2
+    assert not tab.is_change.any()
+    with pytest.raises(ValueError, match="capacity"):
+        ctm.encode_churn(sched, 3, max_events=1)
+    with pytest.raises(ValueError, match="via node"):
+        ctm.encode_churn(
+            ctm.ChurnSchedule((ctm.ChurnEvent(vid=1, via=9),)), 3
+        )
+    with pytest.raises(ValueError, match="changes node"):
+        ctm.encode_churn(
+            ctm.ChurnSchedule((
+                ctm.ChurnEvent(vid=meng.change_vid(5, meng.ADD_ACCEPTOR)),
+            )),
+            3,
+        )
+
+
+def test_encode_churn_batch_stacks_lanes():
+    a = ctm.ChurnSchedule((ctm.ChurnEvent(vid=1),))
+    b = ctm.grow_shrink_schedule(3, 2)
+    tabs = ctm.encode_churn_batch([a, b, None], 3)
+    assert tabs.vid.shape == (3, len(b.events))
+    assert tabs.n_events.tolist() == [1, len(b.events), 0]
+    assert tabs.is_change[1].any()  # lane b carries change vids
+
+
+def test_grow_shrink_schedule_shape():
+    sched = ctm.grow_shrink_schedule(7, 5, values_per_step=1)
+    # 6 values + 6 adds + 2 dels, change vids marked, dels wait Applied
+    assert len(sched.events) == 14
+    kinds = [e.vid >= meng.CHANGE_BASE for e in sched.events]
+    assert sum(kinds) == 8
+    assert sched.events[-1].wait == ctm.WAIT_APPLIED
+
+
+# ---------------- compile-const vs runtime-table parity ----------------
+
+def _active_init(n, i, c):
+    """Initial state with queued work so the parity steps exercise the
+    accept/apply/learn blocks, not just quiet rounds."""
+    st = meng._init(n, i, c)
+    vids = [100, meng.change_vid(1, meng.ADD_ACCEPTOR), 101]
+    pend = st.pend
+    for k, v in enumerate(vids):
+        pend = pend.at[0, k].set(v)
+    return st._replace(pend=pend, tail=st.tail.at[0].set(len(vids)))
+
+
+def test_const_vs_runtime_round_parity_per_round():
+    """The tentpole's mask-parity pin: the compiled-constant and
+    runtime-ScheduleTable builds of the membership round produce
+    IDENTICAL states round for round, on a schedule mixing a
+    partition, a pause, and a deterministic crash point (so the
+    crash-row read parity is covered too)."""
+    n, i = 4, 16
+    c = i * 2 + 8
+    sched = flt.FaultSchedule((
+        flt.partition(2, 6, (0, 1), (2, 3)),
+        flt.pause(4, 9, 2),
+        flt.crash(7, 3),
+    ))
+    rf_c = jax.jit(meng._build_round(
+        n, i, c, crash_rate=500, comp=flt.compile_schedule(sched, n),
+    ))
+    rf_r = jax.jit(meng._build_round(
+        n, i, c, crash_rate=500, runtime_schedule=True,
+    ))
+    tab = jax.tree.map(jnp.asarray, stm.encode_schedule(sched, n, 5))
+    root = prng.root_key(3)
+    st_c = st_r = _active_init(n, i, c)
+    for t in range(sched.horizon + 4):
+        st_c = rf_c(root, st_c)
+        st_r = rf_r(root, st_r, tab)
+        for name, a, b in zip(
+            st_c._fields, jax.tree.leaves(st_c), jax.tree.leaves(st_r)
+        ):
+            assert (np.asarray(a) == np.asarray(b)).all(), (t, name)
+    # the crash point actually fired on both paths
+    assert bool(np.asarray(st_c.crashed)[3])
+
+
+# ---------------- host-stepped vs device-resident drivers ----------------
+
+def test_host_vs_device_decision_log_sha256_parity():
+    """THE tentpole contract: the same ChurnTable through the legacy
+    host-stepped loop (per-round host reads) and through the
+    device-resident while_loop is decision-log sha256-identical, on a
+    churn + crash + pause mix — and so is the runtime-table twin of
+    the same engine."""
+    churn = ctm.grow_shrink_schedule(4, 2, values_per_step=1)
+    sched = flt.FaultSchedule((
+        flt.pause(5, 11, 2),
+        flt.crash(18, 3),
+    ))
+    eng = meng.ChurnEngine(
+        4, 24, churn=churn, schedule=sched, crash_rate=500,
+        max_rounds=400,
+    )
+    dev = eng.run(seed=2)
+    host = eng.run_host(seed=2)
+    assert dev.done and host.done
+    assert dev.rounds == host.rounds
+    assert _sha(dev.decision_log()) == _sha(host.decision_log())
+
+    rt = meng.ChurnEngine(
+        4, 24, runtime_tables=True, max_events=16, max_episodes=4,
+        crash_rate=500, max_rounds=400,
+    )
+    r2 = rt.run(seed=2, churn=churn, schedule=sched)
+    assert _sha(r2.decision_log()) == _sha(dev.decision_log())
+
+
+def test_device_churn_scenario_converges_prefix_consistent():
+    """The device driver completes the grow/shrink scenario with
+    every value chosen exactly once and prefix-consistent applied
+    logs — the invariants the host-driven config-5 test pins, now on
+    the one-dispatch path."""
+    churn = ctm.grow_shrink_schedule(5, 3, values_per_step=1)
+    eng = meng.ChurnEngine(5, 32, churn=churn, max_rounds=600)
+    res = eng.run(seed=0)
+    assert res.done and res.injected == len(churn.events)
+    logs = [meng.applied_log_of(res.state, a) for a in range(5)]
+    validate.check_prefix_consistency(logs)
+    plain = sorted(
+        e.vid for e in churn.events if e.vid < meng.CHANGE_BASE
+    )
+    assert sorted(logs[0].tolist()) == plain
+    counts = np.unique(logs[0], return_counts=True)[1]
+    assert (counts == 1).all()
+
+
+def test_churn_engine_validation_surfaces():
+    churn = ctm.ChurnSchedule((ctm.ChurnEvent(vid=1),))
+    with pytest.raises(ValueError, match="per run"):
+        meng.ChurnEngine(3, 16, churn=churn, runtime_tables=True)
+    eng = meng.ChurnEngine(3, 16, churn=churn)
+    with pytest.raises(ValueError, match="baked its tables"):
+        eng.run(seed=0, churn=churn)
+    rt = meng.ChurnEngine(3, 16, runtime_tables=True, max_events=2)
+    with pytest.raises(ValueError, match="node 0"):
+        rt.run(seed=0, churn=churn,
+               schedule=flt.FaultSchedule((flt.crash(2, 0),)))
+    # pending-ring capacity guard: one node cannot take more events
+    # than the ring's requeue-headroom leaves
+    i = 4
+    too_many = ctm.ChurnSchedule(tuple(
+        ctm.ChurnEvent(vid=100 + k) for k in range(2 * i + 8 - i + 1)
+    ))
+    with pytest.raises(ValueError, match="pending ring"):
+        meng.ChurnEngine(3, i, churn=too_many, max_rounds=50)
+
+
+# ---------------- deterministic crash episodes (PR-8 reversal) ----------
+
+def test_member_crash_episode_fail_stops_like_host_crash():
+    """A scheduled ``crash(t0, node)`` on the host-stepped engine:
+    silent from round t0+1 (the host ``crash()`` timing), epoch
+    recorded for the rejoin guard, quorum denominators unchanged."""
+    sched = flt.FaultSchedule((flt.crash(6, 2),))
+    ms = meng.MemberSim(3, n_instances=24, seed=0, schedule=sched)
+    a = ms.add_acceptor(1)
+    assert ms.run_until(lambda: ms.applied(a), max_rounds=200)
+    b = ms.add_acceptor(2)
+    assert ms.run_until(lambda: ms.applied(b), max_rounds=200)
+    ms.run_rounds(max(0, 8 - int(ms.state.t)))
+    assert 2 in ms.crashed_set()
+    assert 2 in ms._crash_round  # rejoin epoch guard observed it
+    # the crashed acceptor still counts in the quorum denominator
+    assert ms.acceptor_set(0) == {0, 1, 2}
+    # survivors keep choosing through the 2-of-3 live majority
+    ms.propose(0, 55)
+    assert ms.run_until(lambda: ms.chosen(55), max_rounds=400)

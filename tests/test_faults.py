@@ -280,10 +280,14 @@ def test_member_engine_under_pause_and_partition():
 
 def test_member_schedule_record_replay_byte_identical(tmp_path):
     """The schedule is part of the recorded identity: replay re-derives
-    the same decision log byte-for-byte."""
+    the same decision log byte-for-byte.  The schedule mixes a pause
+    with a deterministic crash point — the kind this engine accepts
+    as of PR 12 — so the injection-log round-trip of crash episodes
+    (artifact schema satellite) is covered end to end: the crash must
+    fire at the same round in the replay or the logs diverge."""
     from tpu_paxos.membership import engine as mem
 
-    sched = flt.FaultSchedule((flt.pause(4, 14, 1),))
+    sched = flt.FaultSchedule((flt.pause(4, 14, 1), flt.crash(18, 2)))
     ms = mem.MemberSim(3, n_instances=48, seed=5, schedule=sched)
     cv = ms.add_acceptor(1)
     assert ms.run_until(lambda: ms.applied(cv), 2000)
@@ -291,8 +295,10 @@ def test_member_schedule_record_replay_byte_identical(tmp_path):
         ms.propose(0, v)
         ms.run_rounds(3)
     ms.run_rounds(20)
+    assert 2 in ms.crashed_set()  # the recorded run's crash fired
     path = tmp_path / "inj.json"
     ms.save_injections(path)
     replayed = mem.MemberSim.replay(path)
     assert replayed.decision_log() == ms.decision_log()
     assert replayed.schedule == sched
+    assert 2 in replayed.crashed_set()

@@ -1,0 +1,191 @@
+"""Fleet membership lanes (PR 12): the device-resident churn driver
+vmapped over (seed x churn-schedule x fault-schedule) lanes, judged on
+device by the membership invariant subset.
+
+Contracts: lane-for-lane decision-log parity with single
+``ChurnEngine.run`` executions (the threefry-partitionable argument
+the sim fleet pinned in PR 4), zero XLA compiles on a warm envelope
+dispatch (the PR-5 cache discipline, via
+``fleet/envelope.member_runner_for``), and the on-device verdict —
+quorum-intersection observable, learner catch-up, crash-excused
+coverage — flagging seeded violations while passing clean runs.
+
+The heavier mixed-schedule parity grid is slow-marked; its fast-tier
+coverage is ``test_member_fleet_lane_parity_vs_single`` (2 lanes,
+same code path) plus test_churn_table.py's single-run parity pins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_paxos.analysis import tracecount
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import values as val
+from tpu_paxos.fleet import envelope as env
+from tpu_paxos.fleet import member_runner as mrun
+from tpu_paxos.membership import churn_table as ctm
+from tpu_paxos.membership import engine as meng
+
+N, I = 4, 24
+CHURN = ctm.grow_shrink_schedule(4, 2, values_per_step=1)
+CHURN2 = ctm.grow_shrink_schedule(3, 1, values_per_step=2)
+SCHEDS = [
+    None,
+    flt.FaultSchedule((flt.pause(4, 9, 2),)),
+    flt.FaultSchedule((flt.crash(16, 3), flt.pause(2, 6, 1))),
+    flt.FaultSchedule((flt.partition(3, 8, (0, 1), (2, 3)),)),
+]
+
+
+@pytest.fixture(scope="module")
+def warm_runner():
+    return env.member_runner_for(
+        N, I, max_events=16, max_episodes=4, max_rounds=500
+    )
+
+
+def test_member_fleet_lane_parity_vs_single(warm_runner):
+    seeds = [0, 3]
+    churns = [CHURN, CHURN2]
+    scheds = [SCHEDS[1], SCHEDS[2]]
+    rep = warm_runner.run(seeds, churns, scheds)
+    assert rep.verdict.ok.all(), rep.verdict
+    eng = meng.ChurnEngine(
+        N, I, runtime_tables=True, max_events=16, max_episodes=4,
+        max_rounds=500,
+    )
+    for i in range(rep.n_lanes):
+        single = eng.run(seed=seeds[i], churn=churns[i], schedule=scheds[i])
+        assert rep.lane_log(i) == single.decision_log(), f"lane {i}"
+        assert int(rep.verdict.rounds[i]) == single.rounds
+
+
+def test_member_fleet_warm_dispatch_zero_compiles(warm_runner):
+    census = tracecount.CompileCensus().start()
+    try:
+        warm_runner.run([11, 12], [CHURN, CHURN], [None, SCHEDS[3]])
+        n = sum(census.counts.values())
+    finally:
+        census.stop()
+    assert n == 0, f"warm member-fleet dispatch compiled {n}x"
+    # and the envelope cache hands back the same runner for the key
+    again = env.member_runner_for(
+        N, I, max_events=16, max_episodes=4, max_rounds=500
+    )
+    assert again is warm_runner
+    other = env.member_runner_for(
+        N, I, max_events=8, max_episodes=4, max_rounds=500
+    )
+    assert other is not warm_runner
+
+
+def test_member_fleet_lane_shape_validation(warm_runner):
+    with pytest.raises(ValueError, match="per lane"):
+        warm_runner.run([0, 1], [CHURN], [None, None])
+    with pytest.raises(ValueError, match="node 0"):
+        warm_runner.run(
+            [0], [CHURN], [flt.FaultSchedule((flt.crash(2, 0),))]
+        )
+    big = ctm.ChurnSchedule(tuple(
+        ctm.ChurnEvent(vid=100 + k) for k in range(warm_runner.c - I + 1)
+    ))
+    with pytest.raises(ValueError, match="lane 0.*pending ring"):
+        env.member_runner_for(
+            N, I, max_events=len(big.events), max_episodes=4,
+            max_rounds=500,
+        ).run([0], [big], [None])
+
+
+# ---------------- verdict true positives + clean ----------------
+
+def _clean_final():
+    eng = meng.ChurnEngine(N, I, churn=CHURN, max_rounds=500)
+    res = eng.run(seed=1)
+    assert res.done
+    ctab = ctm.encode_churn(CHURN, N, 16)
+    return res.state, jax.tree.map(jnp.asarray, ctab)
+
+
+def test_member_verdict_clean_state_passes():
+    st, ctab = _clean_final()
+    v = mrun.member_lane_verdict(st, ctab, jnp.bool_(True))
+    assert bool(v.ok) and bool(v.quorum) and bool(v.catchup)
+    assert bool(v.coverage) and bool(v.completed)
+
+
+def test_member_verdict_flags_seeded_quorum_violation():
+    """A learner cell disagreeing with the chosen record — what
+    non-intersecting epoch quorums would produce — must flip the
+    quorum invariant (and only it)."""
+    st, ctab = _clean_final()
+    k = int(np.flatnonzero(
+        np.asarray(st.chosen_vid) != int(val.NONE)
+    )[0])
+    bad = st._replace(learned=st.learned.at[k, 1].set(999_999))
+    v = mrun.member_lane_verdict(bad, ctab, jnp.bool_(True))
+    assert not bool(v.quorum) and not bool(v.ok)
+    assert bool(v.coverage)
+
+
+def test_member_verdict_flags_seeded_catchup_violation():
+    """A live in-view learner missing a chosen instance (a
+    never-drained anti-entropy pull) must flip learner catch-up."""
+    st, ctab = _clean_final()
+    k = int(np.flatnonzero(
+        np.asarray(st.chosen_vid) != int(val.NONE)
+    )[0])
+    # node 1 is a learner in node 0's final view (shrink keeps {0,1})
+    assert bool(np.asarray(st.learners[0])[1])
+    bad = st._replace(learned=st.learned.at[k, 1].set(val.NONE))
+    v = mrun.member_lane_verdict(bad, ctab, jnp.bool_(True))
+    assert not bool(v.catchup) and not bool(v.ok)
+    assert bool(v.quorum)
+
+
+def test_member_verdict_crash_excuses_coverage():
+    """Events injected via a node the lane's schedule crashed are
+    excused from coverage (the sim fleet's crashed-owner rule); the
+    lane still fails on completed=False, so a stalled churn is a
+    finding, not a silent pass."""
+    churn = ctm.ChurnSchedule((
+        ctm.ChurnEvent(vid=300, via=1),
+        ctm.ChurnEvent(vid=301, via=1, wait=ctm.WAIT_CHOSEN, t0=30),
+    ))
+    runner = mrun.MemberFleetRunner(
+        N, I, max_events=4, max_episodes=2, max_rounds=60,
+    )
+    # crash node 1 before its second event can inject: the event is
+    # never chosen, but its via-node crash excuses coverage
+    rep = runner.run(
+        [0], [churn], [flt.FaultSchedule((flt.crash(5, 1),))]
+    )
+    assert not bool(rep.verdict.completed[0])
+    assert bool(rep.verdict.coverage[0])
+    assert not bool(rep.verdict.ok[0])
+    assert rep.failing == [0]
+    # the failing lane's state transfers for triage
+    final = rep.lane_state(0)
+    assert bool(np.asarray(final.crashed)[1])
+
+
+@pytest.mark.slow
+def test_member_fleet_mixed_grid_parity(warm_runner):
+    """Slow tier: the full 4-lane mixed-schedule grid (clean / pause /
+    crash+pause / partition) — per-lane decision logs equal the
+    single-run twins.  Fast-tier coverage:
+    test_member_fleet_lane_parity_vs_single."""
+    seeds = [0, 1, 2, 3]
+    churns = [CHURN, CHURN, CHURN2, CHURN2]
+    rep = warm_runner.run(seeds, churns, SCHEDS)
+    assert rep.verdict.ok.all()
+    eng = meng.ChurnEngine(
+        N, I, runtime_tables=True, max_events=16, max_episodes=4,
+        max_rounds=500,
+    )
+    for i in range(4):
+        single = eng.run(
+            seed=seeds[i], churn=churns[i], schedule=SCHEDS[i]
+        )
+        assert rep.lane_log(i) == single.decision_log(), f"lane {i}"

@@ -261,13 +261,26 @@ def test_crashes_at_matches_compiled_rows():
         assert np.asarray(reach).all()
 
 
-def test_membership_engine_rejects_crash_episodes():
+def test_membership_engine_accepts_crash_episodes():
+    """PR 8 made the membership engine REJECT crash episodes (its
+    round body never read the crash rows); the device-resident
+    rework wired them in, so acceptance — with the actual fail-stop —
+    is now the contract.  Node 0 stays the one rejection: it is the
+    harness driver (the host ``crash()`` injector's rule)."""
     from tpu_paxos.membership import engine as mem
 
-    with pytest.raises(ValueError, match="crash episodes"):
+    ms = mem.MemberSim(
+        3, n_instances=64,
+        schedule=flt.FaultSchedule((flt.crash(2, 1),)),
+    )
+    ms.propose(0, 9)
+    assert ms.run_until(lambda: ms.chosen(9), max_rounds=200)
+    ms.run_rounds(4)
+    assert 1 in ms.crashed_set()
+    with pytest.raises(ValueError, match="node 0"):
         mem.MemberSim(
             3, n_instances=64,
-            schedule=flt.FaultSchedule((flt.crash(2, 1),)),
+            schedule=flt.FaultSchedule((flt.crash(2, 0),)),
         )
 
 

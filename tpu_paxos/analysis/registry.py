@@ -38,6 +38,7 @@ AUDIT_PROVIDERS = (
     "tpu_paxos.parallel.sharded",
     "tpu_paxos.parallel.sharded_sim",
     "tpu_paxos.fleet.runner",
+    "tpu_paxos.fleet.member_runner",
     "tpu_paxos.analysis.modelcheck",
     "tpu_paxos.serve.driver",
 )

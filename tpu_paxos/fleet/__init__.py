@@ -7,7 +7,10 @@ with runtime schedules, and that must not eagerly drag in the runner /
 search stack (which imports the harness).
 """
 
-_SUBMODULES = ("envelope", "runner", "schedule_table", "search", "verdict")
+_SUBMODULES = (
+    "envelope", "member_runner", "runner", "schedule_table", "search",
+    "verdict",
+)
 
 
 def __getattr__(name):

@@ -157,3 +157,62 @@ def runner_for(
         runner.explicit_inputs_only = True
         _CACHE[key] = runner
     return runner
+
+
+def member_envelope_key(
+    n_nodes: int,
+    n_instances: int,
+    max_events: int,
+    max_episodes: int,
+    crash_rate: int,
+    max_rounds: int,
+) -> tuple:
+    """The hashable envelope of a membership fleet — exactly the
+    static facts the compiled churn-lane program depends on: the
+    cluster geometry, the churn-table event capacity, the
+    fault-schedule episode capacity, the i.i.d. crash rate (a traced
+    draw's presence is a compile-time fact in the member engine), and
+    the round budget.  Everything else — seeds, churn scenarios,
+    episode mixes — is a runtime input of the cached executable."""
+    return (
+        "member",
+        int(n_nodes),
+        int(n_instances),
+        int(max_events),
+        int(max_episodes),
+        int(crash_rate),
+        int(max_rounds),
+    )
+
+
+def member_runner_for(
+    n_nodes: int,
+    n_instances: int,
+    *,
+    max_events: int | None = None,
+    max_episodes: int = frun.MAX_EPISODES,
+    crash_rate: int = 0,
+    max_rounds: int = 2000,
+):
+    """The shared compiled membership-fleet runner for this envelope
+    (``fleet/member_runner.MemberFleetRunner``), memoized in the same
+    cache the sim envelopes share: distinct churn scenarios, episode
+    mixes, and seeds then cost dispatches, not compiles."""
+    from tpu_paxos.fleet import member_runner as mrun
+    from tpu_paxos.membership import churn_table as ctm
+
+    if max_events is None:
+        max_events = ctm.MAX_EVENTS
+    key = member_envelope_key(
+        n_nodes, n_instances, max_events, max_episodes, crash_rate,
+        max_rounds,
+    )
+    runner = _CACHE.get(key)
+    if runner is None:
+        runner = mrun.MemberFleetRunner(
+            n_nodes, n_instances, max_events=max_events,
+            max_episodes=max_episodes, crash_rate=crash_rate,
+            max_rounds=max_rounds,
+        )
+        _CACHE[key] = runner
+    return runner

@@ -1,0 +1,297 @@
+"""Device-batched MEMBERSHIP fleets: many (seed x churn-schedule x
+fault-schedule) lanes of the churn engine per XLA dispatch, judged on
+device.
+
+The general-engine fleet (fleet/runner.py) vmaps ``sim``'s whole-run
+while-loop over lanes of schedule tables and knob vectors; this
+module is its membership twin.  Each lane runs the device-resident
+churn driver (``membership/engine.ChurnEngine``'s loop: inject ->
+round -> done?) end to end — the churn table
+(``membership/churn_table.ChurnTable``) and the fault-schedule table
+(``fleet/schedule_table.ScheduleTable``, crash points included) are
+per-lane runtime arrays, so ONE compiled executable covers every
+(churn scenario, episode mix, seed) combination of a fixed envelope
+``(n_nodes, n_instances, max_events, max_episodes, crash_rate,
+max_rounds)``.  ``fleet/envelope.member_runner_for`` memoizes one
+runner per envelope key, the same cache discipline the sim fleet
+earned in PR 5.
+
+On-device MEMBERSHIP invariants (``member_lane_verdict``) reduce each
+lane to booleans inside the same jit, so only failing lanes ever pay
+host transfer:
+
+- **quorum intersection across epochs** — the observable consequence
+  of same-view quorums intersecting across acceptor-set changes: no
+  learner holds a value different from the chosen record for its
+  instance (a divergent learn is exactly what non-intersecting
+  epoch quorums would produce), and no event vid is chosen in two
+  instances (an epoch-boundary double choose);
+- **learner catch-up** — every live node listed as a learner in node
+  0's final view has learned every chosen instance (the anti-entropy
+  pull drained before the run completed);
+- **coverage** — every churn-event vid was chosen, a lane-crashed
+  injecting node excusing its events (the crash-aware rule of the
+  sim fleet's verdict);
+- **completed** — the driver's run-complete predicate held inside
+  the round budget.
+
+Lane-for-lane the fleet is DECISION-LOG-IDENTICAL to single
+``ChurnEngine.run`` executions of the same (churn, schedule, seed):
+``jax_threefry_partitionable`` makes batched draws equal per-lane
+draws (the PR-4/5 parity argument), pinned by
+tests/test_member_fleet.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.analysis import tracecount
+from tpu_paxos.fleet import runner as frun
+from tpu_paxos.fleet import schedule_table as stm
+from tpu_paxos.membership import churn_table as ctm
+from tpu_paxos.membership import engine as meng
+from tpu_paxos.utils import prng
+
+
+class MemberLaneVerdict(NamedTuple):
+    """Per-lane membership verdict vector(s); scalar per lane
+    unbatched, [L] under the fleet vmap (module doc)."""
+
+    ok: jnp.ndarray
+    quorum: jnp.ndarray  # quorum-intersection observable (agreement)
+    catchup: jnp.ndarray  # learner catch-up
+    coverage: jnp.ndarray  # crash-excused event-vid coverage
+    completed: jnp.ndarray  # run-complete inside the budget
+    rounds: jnp.ndarray  # int32 rounds simulated
+
+
+def member_lane_verdict(
+    st: "meng.MemberState", ctab, done
+) -> MemberLaneVerdict:
+    """Judge one (unbatched) final churn-engine state on device — the
+    fleet runner vmaps this inside the same jit as the round loop, so
+    the verdict costs no extra dispatch."""
+    from tpu_paxos.core import values as val
+
+    chosen = st.chosen_vid  # [I]
+    known = st.learned != val.NONE  # [I, N]
+    # quorum intersection across epochs, as observed: a learner cell
+    # disagreeing with the chosen record (incl. a learn where nothing
+    # was chosen — chosen == NONE never equals a learned vid >= 0)
+    agree = jnp.all(~known | (st.learned == chosen[:, None]))
+    evalid = ctab.vid != val.NONE  # [E]; padding slots vacuous
+    hit = ctab.vid[:, None] == chosen[None, :]  # [E, I]
+    n_hit = jnp.sum(hit, axis=1, dtype=jnp.int32)  # [E]
+    no_double = jnp.all(~evalid | (n_hit <= 1))
+    quorum = agree & no_double
+
+    n = st.crashed.shape[0]
+    owed = (~st.crashed) & st.learners[0]  # [N]
+    chosen_i = chosen != val.NONE  # [I]
+    catchup = jnp.all(~chosen_i[:, None] | known | ~owed[None, :])
+
+    via_crashed = st.crashed[jnp.clip(ctab.via, 0, n - 1)]  # [E]
+    coverage = jnp.all(~evalid | (n_hit >= 1) | via_crashed)
+
+    ok = quorum & catchup & coverage & done
+    return MemberLaneVerdict(
+        ok=ok,
+        quorum=quorum,
+        catchup=catchup,
+        coverage=coverage,
+        completed=done,
+        rounds=st.t,
+    )
+
+
+@dataclasses.dataclass
+class MemberFleetReport:
+    """One dispatch's outcome.  ``final`` stays ON DEVICE — only the
+    [lanes]-sized verdict vectors transfer here; callers extract full
+    per-lane states (``lane_state`` / ``lane_log``) for failing lanes
+    only."""
+
+    n_nodes: int
+    n_lanes: int
+    seeds: list
+    churns: list
+    schedules: list
+    verdict: MemberLaneVerdict  # host numpy, [lanes] per field
+    final: object  # device MemberState, lane-leading
+    injected: np.ndarray  # [lanes] events injected
+    seconds: float
+
+    @property
+    def lanes_per_sec(self) -> float:
+        return self.n_lanes / max(self.seconds, 1e-9)
+
+    @property
+    def failing(self) -> list:
+        return [
+            i for i in range(self.n_lanes) if not bool(self.verdict.ok[i])
+        ]
+
+    def lane_state(self, i: int):
+        """Transfer ONE lane's final state (the triage hand-off)."""
+        return jax.tree.map(lambda x: x[i], self.final)
+
+    def lane_log(self, i: int) -> str:
+        """One lane's canonical decision log — byte-equal to the
+        single ``ChurnEngine.run`` of ``(churns[i], schedules[i],
+        seeds[i])`` (the parity contract)."""
+        return meng.decision_log_of(self.lane_state(i))
+
+
+class MemberFleetRunner:
+    """Compile-once membership-fleet front end for one envelope: the
+    jitted vmapped whole-run churn driver plus the on-device member
+    verdict.  ``run()`` is called per generation / per scenario batch
+    with fresh seeds, churn schedules, and fault schedules — same
+    executable."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        n_instances: int,
+        *,
+        max_events: int = ctm.MAX_EVENTS,
+        max_episodes: int = frun.MAX_EPISODES,
+        crash_rate: int = 0,
+        max_rounds: int = 2000,
+    ):
+        self.n = n_nodes
+        self.i = n_instances
+        self.c = n_instances * 2 + 8
+        self.max_events = int(max_events)
+        self.max_episodes = int(max_episodes)
+        self.crash_rate = int(crash_rate)
+        self.max_rounds = int(max_rounds)
+        round_fn = meng._build_round(
+            n_nodes, n_instances, self.c, crash_rate,
+            runtime_schedule=True,
+        )
+        # the SAME whole-run loop ChurnEngine dispatches for single
+        # runs — shared so the lane body can never drift from the
+        # parity twin the tests compare against
+        loop = meng._build_churn_loop(
+            round_fn, self.c, self.max_rounds, runtime_tables=True
+        )
+
+        def lane(root, st, ctab, ftab):
+            final, cur, done = loop(root, st, ctab, ftab)
+            return final, cur, member_lane_verdict(final, ctab, done)
+
+        # the shared initial state broadcasts (in_axes=None): the [I]-
+        # sized arrays upload once, not per lane
+        self._fn = jax.jit(jax.vmap(lane, in_axes=(0, None, 0, 0)))
+
+    def run(self, seeds, churns, schedules) -> MemberFleetReport:
+        """One fleet dispatch: ``seeds[i]``, ``churns[i]``
+        (ChurnSchedule or None), and ``schedules[i]`` (FaultSchedule
+        or None) drive lane ``i``.  Returns once the verdict vector is
+        on the host; the per-lane states stay on device."""
+        seeds = [int(s) for s in seeds]
+        churns = list(churns)
+        schedules = list(schedules)
+        n_lanes = len(seeds)
+        if len(churns) != n_lanes or len(schedules) != n_lanes:
+            raise ValueError("one churn + one schedule per lane required")
+        for s in schedules:
+            meng._check_member_schedule(s)
+        # the capacity proof is the single-run engine's, applied per
+        # lane BEFORE the batch encode (one implementation — a
+        # headroom-rule change cannot diverge between paths)
+        for li, churn_lane in enumerate(churns):
+            meng._check_churn_capacity(
+                ctm.encode_churn(churn_lane, self.n, self.max_events),
+                self.i, self.c, lane=li,
+            )
+        ctabs = ctm.encode_churn_batch(churns, self.n, self.max_events)
+        ftabs = stm.encode_batch(schedules, self.n, self.max_episodes)
+        roots = jnp.stack([prng.root_key(s) for s in seeds])
+        st0 = meng._init(self.n, self.i, self.c)
+        t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
+        with tracecount.engine_scope("member"):
+            final, cur, v = self._fn(
+                roots, st0,
+                jax.tree.map(jnp.asarray, ctabs),
+                jax.tree.map(jnp.asarray, ftabs),
+            )
+        verdict = MemberLaneVerdict(*(np.asarray(x) for x in v))
+        seconds = time.perf_counter() - t0  # verdict transfer = the sync  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
+        return MemberFleetReport(
+            n_nodes=self.n,
+            n_lanes=n_lanes,
+            seeds=seeds,
+            churns=churns,
+            schedules=schedules,
+            verdict=verdict,
+            final=final,
+            injected=np.asarray(cur),
+            seconds=seconds,
+        )
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+def audit_entries():
+    """Canonical membership-fleet trace (analysis/registry.py): 2
+    lanes of a small geometry with distinct churn scenarios AND
+    distinct episode mixes (a pause and a deterministic crash point)
+    through the vmapped whole-run churn driver + the on-device member
+    verdict — the runtime churn-table evaluation, the runtime fault
+    masks, and the verdict reductions are all in the traced program
+    the op budget pins."""
+    from tpu_paxos.analysis.registry import AuditEntry
+    from tpu_paxos.core import faults as fltm
+
+    def build():
+        n, i = 3, 8
+        runner = MemberFleetRunner(
+            n, i, max_events=4, max_episodes=2, crash_rate=500,
+            max_rounds=64,
+        )
+        churns = [
+            ctm.ChurnSchedule((
+                ctm.ChurnEvent(vid=100),
+                ctm.ChurnEvent(
+                    vid=meng.change_vid(1, meng.ADD_ACCEPTOR),
+                    wait=ctm.WAIT_CHOSEN,
+                ),
+            )),
+            ctm.ChurnSchedule((
+                ctm.ChurnEvent(vid=200),
+                ctm.ChurnEvent(vid=201, wait=ctm.WAIT_CHOSEN),
+                ctm.ChurnEvent(
+                    vid=meng.change_vid(2, meng.ADD_ACCEPTOR),
+                    wait=ctm.WAIT_APPLIED,
+                ),
+            )),
+        ]
+        scheds = [
+            fltm.FaultSchedule((fltm.pause(2, 5, 1),)),
+            fltm.FaultSchedule((fltm.crash(8, 2),)),
+        ]
+        ctabs = jax.tree.map(
+            jnp.asarray, ctm.encode_churn_batch(churns, n, 4)
+        )
+        ftabs = jax.tree.map(
+            jnp.asarray, stm.encode_batch(scheds, n, 2)
+        )
+        roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
+        st0 = meng._init(n, i, runner.c)
+        return runner._fn, (roots, st0, ctabs, ftabs)
+
+    return [
+        AuditEntry(
+            "member.fleet_lanes", build,
+            covers=("MemberFleetRunner.__init__",), hlo_golden=True,
+        ),
+    ]

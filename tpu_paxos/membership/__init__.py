@@ -22,11 +22,25 @@ delivers synchronously by calling the peer's OnReceive directly
 (ref member/main.cpp:65-79).
 """
 
+from tpu_paxos.membership.churn_table import (
+    WAIT_APPLIED,
+    WAIT_CHOSEN,
+    WAIT_NONE,
+    ChurnEvent,
+    ChurnSchedule,
+    ChurnTable,
+    encode_churn,
+    encode_churn_batch,
+    grow_shrink_schedule,
+)
 from tpu_paxos.membership.engine import (
     ADD_ACCEPTOR,
     DEL_ACCEPTOR,
+    ChurnEngine,
+    ChurnResult,
     MemberSim,
     change_vid,
+    decision_log_of,
     decode_change,
     is_change_vid,
     membership_suffix,
@@ -35,9 +49,21 @@ from tpu_paxos.membership.engine import (
 __all__ = [
     "ADD_ACCEPTOR",
     "DEL_ACCEPTOR",
+    "WAIT_APPLIED",
+    "WAIT_CHOSEN",
+    "WAIT_NONE",
+    "ChurnEngine",
+    "ChurnEvent",
+    "ChurnResult",
+    "ChurnSchedule",
+    "ChurnTable",
     "MemberSim",
     "change_vid",
+    "decision_log_of",
     "decode_change",
+    "encode_churn",
+    "encode_churn_batch",
+    "grow_shrink_schedule",
     "is_change_vid",
     "membership_suffix",
 ]

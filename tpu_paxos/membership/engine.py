@@ -63,10 +63,25 @@ on Applied/chosen between dependent proposals — the same pattern
 ``MemberSim.run_until`` provides, and
 ``MemberSim.propose_in_order`` packages (see
 tests/test_membership.py).  This engine is the *control-plane*
-variant: churn events are rare and host-paced, so it optimizes for
-reconfiguration semantics, not instance throughput — bulk data-plane
-consensus at scale is core/sim + parallel/sharded_sim, whose
-benchmarks carry the throughput story.
+variant: churn events are rare, so it optimizes for reconfiguration
+semantics, not instance throughput — bulk data-plane consensus at
+scale is core/sim + parallel/sharded_sim, whose benchmarks carry the
+throughput story.
+
+Two drivers share the round function.  ``MemberSim`` is the HOST
+driver — an arbitrary Python program decides the injections round by
+round (faithful to member/main.cpp; the injection log makes it
+replayable) at the cost of a dispatch + predicate reads per round.
+``ChurnEngine`` is the DEVICE-RESIDENT driver: the decisions are
+encoded up front as a runtime ``ChurnTable``
+(membership/churn_table.py) and evaluated inside a
+``lax.while_loop``, so a whole churn scenario is one dispatch — the
+``sim._run_loop`` analog, decision-log sha256-identical to its
+host-stepped twin (``ChurnEngine.run_host``).  Deterministic
+``crash(t0, nodes)`` episodes (core/faults.py) are accepted by both
+drivers on both the compiled-constant and runtime-table schedule
+paths; only node 0 — the harness driver's seat — may not be
+crash-scheduled.
 """
 
 from __future__ import annotations
@@ -82,6 +97,7 @@ import numpy as np
 from tpu_paxos.analysis import tracecount
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import values as val
+from tpu_paxos.membership import churn_table as ctm
 from tpu_paxos.utils import prng
 
 # Change kinds (ref member/paxos.cpp:61-69 enum MembershipChangeType)
@@ -233,17 +249,29 @@ def _build_round(
     n: int,
     i_cap: int,
     c: int,
-    root: jax.Array,
     crash_rate: int = 0,
     comp=None,
+    runtime_schedule: bool = False,
 ):
-    """``comp`` is a compiled fault schedule (core/faults.py) or None.
+    """``comp`` is a compiled fault schedule (core/faults.py) or None;
+    with ``runtime_schedule=True`` the schedule instead arrives as a
+    traced ``fleet/schedule_table.ScheduleTable`` argument (the
+    round becomes ``round_fn(root, st, tab)``) and the per-round masks
+    are computed inside the step — one compiled program covers every
+    episode mix of the table's envelope, decision-log-identical to the
+    compiled-constant path (tests/test_churn_table.py pins it).
     member/'s network is synchronous — request and reply happen in one
     step — so an edge functions only when reachability holds in BOTH
     directions; one-way cuts therefore sever the whole exchange on the
     affected edges (the asymmetric-delivery story belongs to the
     calendar network of core/sim).  Pauses subtract from the alive
-    mask like crashes but preserve state and heal at episode end."""
+    mask like crashes but preserve state and heal at episode end.
+    Deterministic ``crash(t0, nodes)`` episodes fail-stop at the END
+    of round ``t0`` (the i.i.d. injection's timing) and compose with
+    the i.i.d. admission cap: scheduled crashes land first, so the
+    cap's live-majority room accounts for them."""
+    from tpu_paxos.fleet import schedule_table as stm
+
     idx = jnp.arange(i_cap, dtype=jnp.int32)
     rows = jnp.arange(n)
     horizon = comp.horizon if comp is not None else 0
@@ -253,19 +281,29 @@ def _build_round(
     reach_tab = (
         jnp.asarray(comp.reach) if comp is not None and comp.has_reach else None
     )
+    crash_tab = (
+        jnp.asarray(comp.crashed) if comp is not None and comp.has_crash else None
+    )
 
-    def round_fn(st: MemberState) -> MemberState:
+    def _round_core(root, st: MemberState, tab) -> MemberState:
         t = st.t
-        tt = jnp.minimum(t, jnp.int32(horizon)) if comp is not None else None
         exist = ~st.crashed  # [N] not-crashed (excusals key off this)
-        alive = exist  # [N] I/O-alive: crashed or paused act in no role
-        if pause_tab is not None:
-            alive = alive & ~pause_tab[tt]
-        if reach_tab is not None:
-            reach_t = reach_tab[tt]
+        if runtime_schedule:
+            reach_t, pause_t, _extra = stm.masks_at(tab, t)
             reach2_t = reach_t & reach_t.T  # synchronous exchange
+            sched_crash = stm.crashes_at(tab, t)
+            alive = exist & ~pause_t
         else:
-            reach_t = reach2_t = None
+            tt = jnp.minimum(t, jnp.int32(horizon)) if comp is not None else None
+            alive = exist  # [N] I/O-alive: crashed/paused act in no role
+            if pause_tab is not None:
+                alive = alive & ~pause_tab[tt]
+            if reach_tab is not None:
+                reach_t = reach_tab[tt]
+                reach2_t = reach_t & reach_t.T  # synchronous exchange
+            else:
+                reach_t = reach2_t = None
+            sched_crash = crash_tab[tt] if crash_tab is not None else None
         # node-local roles (a node acts on its OWN view of itself;
         # crashed nodes act in no role)
         is_prop = st.proposers[rows, rows] & alive  # [N]
@@ -320,75 +358,107 @@ def _build_round(
             best_b = jnp.full((i_cap, n), bal.NONE, jnp.int32)
             best_v = jnp.full((i_cap, n), val.NONE, jnp.int32)
             lbest = jnp.full((i_cap, n), _NEG, jnp.int32)
-            new_acks, n_ack_rows = [], []
+            any_new = jnp.zeros((i_cap,), jnp.bool_)
+            new_v = jnp.full((i_cap,), _NEG, jnp.int32)
+            new_b = jnp.full((i_cap,), _NEG, jnp.int32)
+            none_yet = cvid == val.NONE  # [I]
+            new_acks, newly_rows = [], []
             w_has = st.cur_batch != val.NONE  # [V, I]
+            # Per-proposer cond: only proposers with an open accept
+            # batch this round (send_acc[v]) pay their [I, A] passes.
+            # Exact by the same argument as the outer gate — for
+            # ~send_acc[v], ackv is all-false (elig[v] ⊆ send_acc[v]),
+            # so best/acks/lbest contributions are identities, and
+            # inst_chosen[v] is all-false (an open batch implies
+            # prepared & alive, which with w_has is send_acc).  In the
+            # common churn regime ONE proposer drives, so this turns
+            # a V-fold unrolled cube walk into a single pass.
             for v in range(n):
-                batv = st.cur_batch[v]  # [I]
-                ackv = (
-                    elig[v][None, :]
-                    & w_has[v][:, None]
-                    & jnp.where(
-                        is_comm,
-                        batv[:, None] == learned,
-                        st.ballot[v] >= acc_ballot,
+                def _active(ops, v=v):
+                    best_b, best_v, lbest, any_new, new_v, new_b = ops
+                    batv = st.cur_batch[v]  # [I]
+                    ackv = (
+                        elig[v][None, :]
+                        & w_has[v][:, None]
+                        & jnp.where(
+                            is_comm,
+                            batv[:, None] == learned,
+                            st.ballot[v] >= acc_ballot,
+                        )
+                    )  # [I, A]
+                    candv = jnp.where(
+                        ackv & ~is_comm, st.ballot[v], bal.NONE
                     )
-                )  # [I, A]
-                candv = jnp.where(ackv & ~is_comm, st.ballot[v], bal.NONE)
-                take = candv > best_b
-                best_b = jnp.where(take, candv, best_b)
-                best_v = jnp.where(
-                    take, jnp.broadcast_to(batv[:, None], best_v.shape),
-                    best_v,
-                )
-                av_new = acks[v] | ackv
+                    take = candv > best_b
+                    best_b = jnp.where(take, candv, best_b)
+                    best_v = jnp.where(
+                        take,
+                        jnp.broadcast_to(batv[:, None], best_v.shape),
+                        best_v,
+                    )
+                    av_new = acks[v] | ackv
+                    # per-instance quorum over the proposer's view
+                    n_ack = jnp.sum(
+                        av_new & st.acceptors[v][None, :], axis=-1,
+                        dtype=jnp.int32,
+                    )
+                    # A crashed proposer can no longer detect (or
+                    # broadcast) a choice even if its accumulated acks
+                    # reach quorum; the value stays accepted-by-quorum
+                    # until some live proposer re-prepares and adopts
+                    # it.
+                    chosen_v = (
+                        w_has[v] & (n_ack >= quorum_v[v]) & alive[v]
+                    )
+                    newly_v = chosen_v & none_yet
+                    any_new = any_new | newly_v
+                    new_v = jnp.maximum(
+                        new_v, jnp.where(newly_v, batv, _NEG)
+                    )
+                    new_b = jnp.maximum(
+                        new_b, jnp.where(newly_v, st.ballot[v], _NEG)
+                    )
+                    # LEARN broadcast (synchronous, to the chooser's
+                    # view-learners; ref Learner::OnLearn) — chosen
+                    # values reach every listed learner this round
+                    le_v = (
+                        chosen_v[:, None]
+                        & st.learners[v][None, :]
+                        & alive[None, :]  # crashed/paused learn nothing
+                    )  # [I, L]
+                    if reach_t is not None:
+                        le_v = le_v & reach_t[v][None, :]
+                    lbest = jnp.maximum(
+                        lbest, jnp.where(le_v, batv[:, None], _NEG)
+                    )
+                    return (
+                        (best_b, best_v, lbest, any_new, new_v, new_b),
+                        av_new,
+                        jnp.any(newly_v),
+                    )
+
+                def _idle(ops, v=v):
+                    return ops, acks[v], jnp.bool_(False)
+
+                ops = (best_b, best_v, lbest, any_new, new_v, new_b)
+                (best_b, best_v, lbest, any_new, new_v, new_b), av_new, \
+                    newly_v_any = jax.lax.cond(
+                        send_acc[v], _active, _idle, ops
+                    )
                 new_acks.append(av_new)
-                # per-instance quorum over the proposer's view acceptors
-                n_ack_rows.append(jnp.sum(
-                    av_new & st.acceptors[v][None, :], axis=-1,
-                    dtype=jnp.int32,
-                ))
+                newly_rows.append(newly_v_any)
             acks = jnp.stack(new_acks)
-            n_ack = jnp.stack(n_ack_rows)  # [V, I]
             do_store = best_b != bal.NONE
             acc_ballot = jnp.where(do_store, best_b, acc_ballot)
             acc_vid = jnp.where(do_store, best_v, acc_vid)
-            # A crashed proposer can no longer detect (or broadcast) a
-            # choice even if its accumulated acks reach quorum; the
-            # value stays accepted-by-quorum until some live proposer
-            # re-prepares and adopts it.
-            inst_chosen = (
-                w_has & (n_ack >= quorum_v[:, None]) & alive[:, None]
-            )
-            newly = inst_chosen & (cvid[None] == val.NONE)
-            any_new = jnp.any(newly, axis=0)
-            new_v = jnp.max(jnp.where(newly, st.cur_batch, _NEG), axis=0)
-            new_b = jnp.max(
-                jnp.where(newly, st.ballot[:, None], _NEG), axis=0
-            )
             cvid = jnp.where(any_new, new_v, cvid)
             cround = jnp.where(any_new, t, cround)
             cballot = jnp.where(any_new, new_b, cballot)
-
-            # LEARN broadcast (synchronous, to the chooser's
-            # view-learners; ref Learner::OnLearn) — chosen values
-            # reach every listed learner this round
-            for v in range(n):
-                le_v = (
-                    inst_chosen[v][:, None]
-                    & st.learners[v][None, :]
-                    & alive[None, :]  # crashed/paused learners learn nothing
-                )  # [I, L]
-                if reach_t is not None:
-                    le_v = le_v & reach_t[v][None, :]
-                lbest = jnp.maximum(
-                    lbest,
-                    jnp.where(le_v, st.cur_batch[v][:, None], _NEG),
-                )
             learned = jnp.where(
                 (lbest != _NEG) & (learned == val.NONE), lbest, learned
             )
             return (acc_ballot, acc_vid, acks, cvid, cround, cballot,
-                    learned, jnp.any(newly, axis=1))
+                    learned, jnp.stack(newly_rows))
 
         (acc_ballot, acc_vid, acks, chosen_vid, chosen_round,
          chosen_ballot, learned, newly_any) = jax.lax.cond(
@@ -405,11 +475,18 @@ def _build_round(
         # reference's learner-side Learn retry for unlearned instances,
         # ref member/paxos.cpp:1029-1073): one instance per round.
         # Node nn may pull from any donor m that has it and whose view
-        # lists nn as a learner (st.learners[m, nn]).
+        # lists nn as a learner (st.learners[m, nn]).  The frontier
+        # (= length of the leading learned run) is the first-gap
+        # index: argmax of the gap mask, one fused pass where the old
+        # cumprod+sum scan paid several (exact: argmax returns the
+        # FIRST max, i.e. the first gap; a gapless log falls back to
+        # the same i_cap the run-length sum produced, then clips).
+        gap = learned.T == val.NONE  # [N, I]
         f = jnp.clip(
-            jnp.sum(
-                jnp.cumprod((learned.T != val.NONE).astype(jnp.int32), axis=1),
-                axis=1,
+            jnp.where(
+                jnp.any(gap, axis=1),
+                jnp.argmax(gap, axis=1).astype(jnp.int32),
+                jnp.int32(i_cap),
             ),
             0,
             i_cap - 1,
@@ -438,8 +515,15 @@ def _build_round(
         app = lme != val.NONE
         nonchg = app & (lme < CHANGE_BASE)
         pre = idx[None] < fa[:, None]
-        run_total = jnp.sum(
-            jnp.cumprod((nonchg | pre).astype(jnp.int32), axis=1), axis=1
+        # run_total = length of the leading applicable run == first
+        # blocker index (argmax of the stop mask; blocker-free rows
+        # fall back to i_cap) — one fused pass, same value as the old
+        # cumprod+sum run-length scan
+        stop = ~(nonchg | pre)  # [N, I]
+        run_total = jnp.where(
+            jnp.any(stop, axis=1),
+            jnp.argmax(stop, axis=1).astype(jnp.int32),
+            jnp.int32(i_cap),
         )
         run = jnp.maximum(run_total - fa, 0)  # plain values applied now
         run = jnp.where(alive, run, 0)  # crashed logs freeze at crash
@@ -639,48 +723,76 @@ def _build_round(
                 learned != val.NONE, COMMITTED_BALLOT, acc_ballot
             )
             snap_v = jnp.where(learned != val.NONE, learned, acc_vid)
-            repb = jnp.where(
-                grant[:, None, :],
-                jnp.broadcast_to(snap_b[None], (n, i_cap, n)),
-                bal.NONE,
-            )
-            best_ab = jnp.max(repb, axis=-1)  # [V, I]
-            sel = (repb == best_ab[..., None]) & (repb != bal.NONE)
-            best_av = jnp.max(
-                jnp.where(sel, snap_v[None], jnp.iinfo(jnp.int32).min),
-                axis=-1,
-            )
-            adopted_b = jnp.where(
-                now_prep[:, None],
-                jnp.where(best_ab > 0, best_ab, bal.NONE),
-                bal.NONE,
-            )
-            adopted_v = jnp.where(
-                now_prep[:, None] & (best_ab > 0), best_av, val.NONE
-            )
+            nones_row = jnp.full((i_cap,), bal.NONE, jnp.int32)
+            ab_rows, av_rows, cb_rows, ak_rows = [], [], [], []
+            # Per-proposer cond (the accept phase's discipline): only
+            # proposers with a prepare in flight (want_prep[v]) pay
+            # the [I, A] snapshot-reply max passes — for everyone
+            # else the adopted rows are NONE and batch/acks pass
+            # through, exactly what the masked forms computed
+            # (now_prep ⊆ want_prep).
+            for v in range(n):
+                def _active(cb_v, ak_v, v=v):
+                    repb = jnp.where(
+                        grant[v][None, :], snap_b, bal.NONE
+                    )  # [I, A]
+                    best_ab = jnp.max(repb, axis=-1)  # [I]
+                    sel = (repb == best_ab[:, None]) & (repb != bal.NONE)
+                    best_av = jnp.max(
+                        jnp.where(
+                            sel, snap_v, jnp.iinfo(jnp.int32).min
+                        ),
+                        axis=-1,
+                    )
+                    adopted_b_v = jnp.where(
+                        now_prep[v],
+                        jnp.where(best_ab > 0, best_ab, bal.NONE),
+                        bal.NONE,
+                    )
+                    adopted_v_v = jnp.where(
+                        now_prep[v] & (best_ab > 0), best_av, val.NONE
+                    )
+                    # batch skeleton: adopted + noop holes + own tail
+                    use_adopt = (
+                        ~committed_me[v] & (adopted_b_v != bal.NONE)
+                    )
+                    covered0 = committed_me[v] | use_adopt
+                    hi = jnp.max(jnp.where(covered0, idx, -1))
+                    below = idx <= hi
+                    noop_fill = below & ~covered0
+                    use_own = ~below & (own_assign[v] != val.NONE)
+                    batch0 = jnp.where(
+                        use_adopt,
+                        adopted_v_v,
+                        jnp.where(
+                            noop_fill,
+                            val.noop_vid(idx, jnp.int32(v), i_cap),
+                            jnp.where(use_own, own_assign[v], val.NONE),
+                        ),
+                    )
+                    batch0 = jnp.where(committed_me[v], val.NONE, batch0)
+                    return (
+                        adopted_b_v,
+                        adopted_v_v,
+                        jnp.where(now_prep[v], batch0, cb_v),
+                        jnp.where(now_prep[v], False, ak_v),
+                    )
 
-            # batch skeleton for the newly prepared: adopted + noop holes
-            use_adopt = ~committed_me & (adopted_b != bal.NONE)
-            covered0 = committed_me | use_adopt
-            hi = jnp.max(jnp.where(covered0, idx[None], -1), axis=1)
-            below = idx[None] <= hi[:, None]
-            noop_fill = below & ~covered0
-            use_own = ~below & (own_assign != val.NONE)
-            batch0 = jnp.where(
-                use_adopt,
-                adopted_v,
-                jnp.where(
-                    noop_fill,
-                    val.noop_vid(idx[None], rows[:, None], i_cap),
-                    jnp.where(use_own, own_assign, val.NONE),
-                ),
-            )
-            batch0 = jnp.where(committed_me, val.NONE, batch0)
+                def _idle(cb_v, ak_v):
+                    return nones_row, nones_row, cb_v, ak_v
+
+                ab_v, av_v, cb_v, ak_v = jax.lax.cond(
+                    want_prep[v], _active, _idle, cur_batch[v], acks[v]
+                )
+                ab_rows.append(ab_v)
+                av_rows.append(av_v)
+                cb_rows.append(cb_v)
+                ak_rows.append(ak_v)
             return (
-                adopted_b,
-                adopted_v,
-                jnp.where(now_prep[:, None], batch0, cur_batch),
-                jnp.where(now_prep[:, None, None], False, acks),
+                jnp.stack(ab_rows),
+                jnp.stack(av_rows),
+                jnp.stack(cb_rows),
+                jnp.stack(ak_rows),
             )
 
         def _no_prep(cur_batch, acks):
@@ -727,25 +839,34 @@ def _build_round(
         )
 
         # ---------- crash injection ----------
+        # Deterministic crash points land first: a ``crash(t0, nodes)``
+        # episode fail-stops its nodes at the END of round t0 — the
+        # same takes-effect-next-round timing as the i.i.d. draw below
+        # — and, landing first, shrinks the live-majority room the
+        # i.i.d. admission cap sees (the composition order the general
+        # engine uses).  Scheduled crashes are NOT admission-capped:
+        # the schedule is the author's deterministic fault model, the
+        # same contract as the general engine's crash episodes.
+        base = exist if sched_crash is None else exist & ~sched_crash
         # Bernoulli(crash_rate/1e6) per live node per round (ref
         # member/indet.h:146-150 RandomFailure), admitted one candidate
         # at a time: a crash is allowed only if every node that would
         # remain alive keeps a live majority of its own view's
         # acceptors (the cap that lets survivors keep running where the
         # reference aborts the whole process).  Node 0 is the harness
-        # driver and never crashes.  Static unroll over candidates — n
-        # is the node count, <= 32 by construction.
-        crashed = st.crashed
+        # driver and never crashes (scheduled crashes of node 0 are
+        # rejected host-side at build time).  Static unroll over
+        # candidates — n is the node count, <= 32 by construction.
         if crash_rate:
             ku = prng.stream(root, prng.STREAM_CRASH, t)
             u = jax.random.randint(ku, (n,), 0, 1_000_000)
-            # admission works over the not-crashed mask (`exist`), NOT
+            # admission works over the not-crashed mask (`base`), NOT
             # the I/O-alive one: a paused node resumes, so it still
             # counts toward live majorities and must never be folded
             # into the crash set by the `~alive_c` complement below
-            want = (u < crash_rate) & exist
+            want = (u < crash_rate) & base
             qv_new = jnp.sum(acceptors_v, axis=1, dtype=jnp.int32) // 2 + 1
-            alive_c = exist
+            alive_c = base
             for x in range(1, n):
                 still = alive_c & (rows != x)
                 live_acc = jnp.sum(
@@ -754,6 +875,8 @@ def _build_round(
                 ok = jnp.all(~still | (live_acc >= qv_new))
                 alive_c = jnp.where(want[x] & ok, still, alive_c)
             crashed = ~alive_c
+        else:
+            crashed = ~base
 
         return MemberState(
             t=t + 1,
@@ -788,7 +911,446 @@ def _build_round(
             chosen_ballot=chosen_ballot,
         )
 
+    if runtime_schedule:
+        def round_fn(root, st: MemberState, tab) -> MemberState:
+            return _round_core(root, st, tab)
+    else:
+        def round_fn(root, st: MemberState) -> MemberState:
+            return _round_core(root, st, None)
+
     return round_fn
+
+
+def _check_member_schedule(schedule) -> None:
+    """Membership-engine schedule constraints: deterministic crash
+    episodes are accepted (dense per-round node-axis masks on both
+    the compiled-constant and runtime-table paths) — but never of
+    node 0, which plays the reference harness's driver role
+    (member/main.cpp proposes and churns through nodes[0]; the
+    host ``crash()`` injector enforces the same rule)."""
+    if schedule is None:
+        return
+    for e in schedule.episodes:
+        if e.kind == "crash" and 0 in e.nodes:
+            raise ValueError(
+                "node 0 is the harness driver; it stays up (crash "
+                f"episode at t0={e.t0} names node 0)"
+            )
+
+
+# ---------------- device-resident churn driver ----------------------
+
+def applied_log_of(state: MemberState, node: int) -> np.ndarray:
+    """Real (non-noop, non-change) values ``node`` has applied, in
+    order — what the reference's checking StateMachine collects
+    (ref member/main.cpp:223-233).  Free function over a final state
+    so both drivers (host-stepped ``MemberSim`` and the device
+    ``ChurnEngine``) share one decision-log surface."""
+    upto = int(state.applied_upto[node])
+    col = np.asarray(state.learned[:upto, node])
+    return col[(col >= 0) & (col < CHANGE_BASE)]
+
+
+def decision_log_of(state: MemberState) -> str:
+    """Canonical decision-log text — chosen (vid, round, ballot) per
+    instance plus each node's applied log — the byte-compare surface
+    for record-vs-replay AND for host-stepped-vs-device-resident
+    driver parity (mirrors member/diff.sh diffing two runs' logs).
+    The node count comes from the state itself, so a caller can never
+    truncate or over-read the applied[] lines."""
+    cv = np.asarray(state.chosen_vid)
+    cr = np.asarray(state.chosen_round)
+    cb = np.asarray(state.chosen_ballot)
+    lines = [
+        f"[{i}] = <{cv[i]}>@{cr[i]}#{cb[i]}"
+        for i in np.flatnonzero(cv != int(val.NONE))
+    ]
+    for node in range(state.crashed.shape[0]):
+        seq = " ".join(map(str, applied_log_of(state, node).tolist()))
+        lines.append(f"applied[{node}] = {seq}")
+    return "\n".join(lines) + "\n"
+
+
+def _chosen_applied(st: MemberState, vid):
+    """Traced ``(chosen, applied)`` pair for one vid — the wait-gate
+    predicates, computed exactly as ``MemberSim.chosen`` /
+    ``MemberSim.applied(viewer=0)`` read them on host: Applied = a
+    majority of node 0's CURRENT acceptor view has learned the
+    instance where ``vid`` was chosen."""
+    inst = st.chosen_vid == vid  # [I]
+    chosen = jnp.any(inst)
+    k = jnp.argmax(inst).astype(jnp.int32)  # first hit (unique per vid)
+    row = st.learned[k]  # [N] learner copies at that instance
+    acc0 = st.acceptors[0]
+    quorum = jnp.sum(acc0, dtype=jnp.int32) // 2 + 1
+    n_learned = jnp.sum(acc0 & (row != val.NONE), dtype=jnp.int32)
+    return chosen, chosen & (n_learned >= quorum)
+
+
+def _churn_inject(ctab, cursor, st: MemberState, c: int):
+    """One driver decision inside the traced step: if the cursor's
+    event is ready (t >= t0 and the wait gate on the previous event
+    holds), push its vid into ``via``'s pending ring at the tail and
+    advance the cursor.  At most one injection per round — the
+    sequential pacing of the reference churn driver.  Returns
+    ``(st, cursor)``."""
+    e_cap = ctab.vid.shape[0]
+    e = jnp.minimum(cursor, jnp.int32(e_cap - 1))
+    valid = cursor < ctab.n_events
+    w = ctab.wait[e]
+    prev_vid = ctab.vid[jnp.maximum(e - 1, 0)]
+    prev_chosen, prev_applied = _chosen_applied(st, prev_vid)
+    gate = (
+        (w == jnp.int32(ctm.WAIT_NONE))
+        | ((w == jnp.int32(ctm.WAIT_CHOSEN)) & prev_chosen)
+        | ((w == jnp.int32(ctm.WAIT_APPLIED)) & prev_applied)
+    )
+    ready = valid & (st.t >= ctab.t0[e]) & gate
+    via = ctab.via[e]
+    # guarded scatter: a not-ready round writes to the out-of-range
+    # slot and drops — no [N, C]-sized select ever materializes
+    pos = jnp.where(ready, st.tail[via], jnp.int32(c))
+    pend = st.pend.at[via, pos].set(ctab.vid[e], mode="drop")
+    tail = st.tail.at[via].add(jnp.where(ready, 1, 0))
+    return (
+        st._replace(pend=pend, tail=tail),
+        cursor + ready.astype(jnp.int32),
+    )
+
+
+def _churn_done(ctab, cursor, st: MemberState):
+    """Run-complete predicate: every event injected, every event vid
+    chosen, the LAST change event Applied (changes are wait-sequenced,
+    so earlier changes were each other's gates), and every live
+    learner in node 0's final view caught up to the chosen log (the
+    anti-entropy pull has drained).  The full check is cond-gated on
+    all-injected, so steady-state rounds pay one scalar compare."""
+    e_cap = ctab.vid.shape[0]
+    all_injected = cursor >= ctab.n_events
+
+    def _full(st):
+        eix = jnp.arange(e_cap, dtype=jnp.int32)
+        evalid = eix < ctab.n_events
+        hit = ctab.vid[:, None] == st.chosen_vid[None, :]  # [E, I]
+        chosen_all = jnp.all(jnp.any(hit, axis=1) | ~evalid)
+        is_chg = ctab.is_change & evalid
+        last = jnp.max(jnp.where(is_chg, eix, jnp.int32(-1)))
+        _, last_applied = _chosen_applied(
+            st, ctab.vid[jnp.maximum(last, 0)]
+        )
+        changes_ok = (last < 0) | last_applied
+        chosen_i = st.chosen_vid != val.NONE  # [I]
+        known = st.learned != val.NONE  # [I, N]
+        owed = (~st.crashed) & st.learners[0]  # [N]
+        caught_up = jnp.all(
+            ~chosen_i[:, None] | known | ~owed[None, :]
+        )
+        return chosen_all & changes_ok & caught_up
+
+    return jax.lax.cond(
+        all_injected, _full, lambda st: jnp.bool_(False), st
+    )
+
+
+def _applied_host(st: MemberState, vid: int) -> bool:
+    """Host mirror of the traced Applied predicate (`_chosen_applied`):
+    same formula over np reads of the same state values."""
+    cv = np.asarray(st.chosen_vid)
+    hits = np.flatnonzero(cv == vid)
+    if not hits.size:
+        return False
+    row = np.asarray(st.learned[int(hits[0])])
+    acc0 = np.asarray(st.acceptors[0])
+    return int((acc0 & (row != int(val.NONE))).sum()) >= int(acc0.sum()) // 2 + 1
+
+
+def _ready_host(ctab, cur: int, st: MemberState) -> bool:
+    """Host mirror of the traced injection gate in `_churn_inject`.
+    Each call transfers the decision inputs to host — the per-round
+    sync the device-resident driver exists to remove."""
+    if cur >= int(ctab.n_events) or int(st.t) < int(ctab.t0[cur]):
+        return False
+    w = int(ctab.wait[cur])
+    if w == ctm.WAIT_NONE:
+        return True
+    prev_vid = int(ctab.vid[max(cur - 1, 0)])
+    chosen = bool((np.asarray(st.chosen_vid) == prev_vid).any())
+    if w == ctm.WAIT_CHOSEN:
+        return chosen
+    return chosen and _applied_host(st, prev_vid)
+
+
+def _done_host(ctab, cur: int, st: MemberState) -> bool:
+    """Host mirror of the traced run-complete predicate `_churn_done`."""
+    n_events = int(ctab.n_events)
+    if cur < n_events:
+        return False
+    cv = np.asarray(st.chosen_vid)
+    vids = np.asarray(ctab.vid)[:n_events]
+    if not np.isin(vids, cv).all():
+        return False
+    chg = np.flatnonzero(np.asarray(ctab.is_change)[:n_events])
+    if chg.size and not _applied_host(st, int(vids[int(chg[-1])])):
+        return False
+    learned = np.asarray(st.learned)  # [I, N]
+    owed = ~np.asarray(st.crashed) & np.asarray(st.learners[0])
+    chosen_i = cv != int(val.NONE)
+    return not (
+        chosen_i[:, None] & (learned == int(val.NONE)) & owed[None, :]
+    ).any()
+
+
+def _check_churn_capacity(
+    ctab, i_cap: int, c: int, lane: int | None = None
+) -> None:
+    """The pending-ring capacity proof, ONE implementation for both
+    drivers and the fleet (MemberSim.propose's headroom rule): i_cap
+    slots stay reserved for conflict requeues, so all of a node's
+    injected events must fit below ``c - i_cap`` — then the device
+    path's guarded tail scatter provably never clamps."""
+    per_via = np.bincount(
+        np.asarray(ctab.via)[: int(ctab.n_events)], minlength=1
+    )
+    if per_via.size and int(per_via.max()) > c - i_cap:
+        where = (
+            f"lane {lane}'s churn schedule" if lane is not None
+            else "churn schedule"
+        )
+        raise ValueError(
+            f"{where} injects {int(per_via.max())} events via one "
+            f"node; the pending ring holds {c - i_cap} (requeue "
+            "headroom reserved)"
+        )
+
+
+def _build_churn_loop(round_fn, c: int, max_rounds: int,
+                      runtime_tables: bool):
+    """The whole-run churn loop — inject -> round -> run-complete? as
+    one ``lax.while_loop`` — shared by ``ChurnEngine`` (single runs)
+    and the fleet lane body (``fleet/member_runner.py`` vmaps it), so
+    the two can never drift apart on termination or injection
+    ordering.  Returns ``go(root, st, ctab, ftab) -> (final_state,
+    cursor, done)``; the round budget extends past the fault table's
+    (traced) horizon, the heal-then-converge contract."""
+    budget = jnp.int32(max_rounds)
+
+    def go(root, st: MemberState, ctab, ftab):
+        def cond(carry):
+            s, _cur, done = carry
+            return (~done) & (
+                s.t < budget + jnp.asarray(ftab.horizon, jnp.int32)
+            )
+
+        def body(carry):
+            s, cur, _done = carry
+            s, cur = _churn_inject(ctab, cur, s, c)
+            s = (
+                round_fn(root, s, ftab) if runtime_tables
+                else round_fn(root, s)
+            )
+            return s, cur, _churn_done(ctab, cur, s)
+
+        return jax.lax.while_loop(
+            cond, body, (st, jnp.int32(0), jnp.bool_(False))
+        )
+
+    return go
+
+
+class ChurnResult(NamedTuple):
+    """One churn run's outcome (host-side wrapper)."""
+
+    state: MemberState
+    rounds: int
+    done: bool
+    injected: int
+
+    def decision_log(self) -> str:
+        return decision_log_of(self.state)
+
+
+class ChurnEngine:
+    """Device-resident churn driver: the whole (inject -> round ->
+    done?) loop as ONE ``lax.while_loop`` dispatch — the membership
+    analog of ``sim._run_loop``.  The host driver's per-round
+    decisions (``MemberSim`` + a Python churn loop) become data: a
+    :class:`~tpu_paxos.membership.churn_table.ChurnTable` of events
+    evaluated inside the traced step, so no per-round host sync
+    remains and the engine runs at the round body's speed.
+
+    Two build modes, decision-log sha256-identical per (churn,
+    schedule, seed) — the ``ScheduleTable`` parity discipline:
+
+    - **compile-time-constant** (default): ``churn`` and ``schedule``
+      bake into the closure as constants — the single-run default,
+      zero per-round table overhead beyond the masks themselves;
+    - **runtime tables** (``runtime_tables=True``): the churn table
+      AND the fault-schedule table arrive per ``run()`` call, so one
+      compiled executable covers every (churn, schedule, seed) mix of
+      the ``(max_events, max_episodes)`` envelope — the surface the
+      fleet's membership lanes vmap (fleet/member_runner.py).
+
+    ``run_host()`` drives the SAME tables with the legacy host-stepped
+    loop (one jitted round per dispatch, injection and termination
+    decided from per-round host reads) — the honest baseline the
+    BENCH_member comparison times, and the parity twin the sha256
+    contract is pinned against."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        n_instances: int,
+        *,
+        churn=None,
+        schedule=None,
+        crash_rate: int = 0,
+        max_rounds: int = 2000,
+        runtime_tables: bool = False,
+        max_events: int | None = None,
+        max_episodes: int | None = None,
+    ):
+        from tpu_paxos.core import faults as fltm
+        from tpu_paxos.fleet import schedule_table as stm
+
+        self.n = n_nodes
+        self.i = n_instances
+        self.c = n_instances * 2 + 8
+        self.crash_rate = crash_rate
+        self.max_rounds = int(max_rounds)
+        self.runtime_tables = bool(runtime_tables)
+        self._round = _build_round(
+            n_nodes, n_instances, self.c, crash_rate,
+            comp=(
+                None if runtime_tables
+                else fltm.compile_schedule(schedule, n_nodes)
+            ),
+            runtime_schedule=runtime_tables,
+        )
+        if runtime_tables:
+            if churn is not None or schedule is not None:
+                raise ValueError(
+                    "runtime_tables=True takes churn/schedule per "
+                    "run() call, not at build time"
+                )
+            self.max_events = (
+                ctm.MAX_EVENTS if max_events is None else int(max_events)
+            )
+            from tpu_paxos.fleet import runner as frun
+
+            self.max_episodes = (
+                frun.MAX_EPISODES if max_episodes is None
+                else int(max_episodes)
+            )
+            self._ctab = self._ftab = None
+            self.schedule = self.churn = None
+        else:
+            _check_member_schedule(schedule)
+            self.schedule = schedule
+            self.churn = churn
+            self._ctab = ctm.encode_churn(churn, n_nodes)
+            self._ftab = stm.encode_schedule(schedule, n_nodes)
+            self.max_events = int(self._ctab.vid.shape[0])
+            self.max_episodes = int(self._ftab.t0.shape[0])
+        self._validate_capacity = self._capacity_checker()
+        if not runtime_tables:
+            self._validate_capacity(self._ctab)
+        _go = _build_churn_loop(
+            self._round, self.c, self.max_rounds, runtime_tables
+        )
+        if runtime_tables:
+            self._go = jax.jit(_go)
+        else:
+            ctab_c = jax.tree.map(jnp.asarray, self._ctab)
+            ftab_c = jax.tree.map(jnp.asarray, self._ftab)
+            self._go = jax.jit(
+                lambda root, st: _go(root, st, ctab_c, ftab_c)
+            )
+        # the host-stepped twin's single-round step: injection applied
+        # on device, but DECIDED from host-side reads (run_host)
+        self._step = jax.jit(self._round)
+
+    def _capacity_checker(self):
+        i_cap, c = self.i, self.c
+
+        def check(ctab) -> None:
+            _check_churn_capacity(ctab, i_cap, c)
+
+        return check
+
+    def _tables(self, churn, schedule):
+        from tpu_paxos.fleet import schedule_table as stm
+
+        if not self.runtime_tables:
+            if churn is not None or schedule is not None:
+                raise ValueError(
+                    "this engine baked its tables at build time; "
+                    "build with runtime_tables=True to pass them per "
+                    "run"
+                )
+            return self._ctab, self._ftab
+        _check_member_schedule(schedule)
+        ctab = ctm.encode_churn(churn, self.n, self.max_events)
+        ftab = stm.encode_schedule(schedule, self.n, self.max_episodes)
+        return ctab, ftab
+
+    def run(self, seed: int = 0, churn=None, schedule=None) -> ChurnResult:
+        """One dispatch: init -> while_loop -> final state.  In
+        runtime-table mode ``churn``/``schedule`` select the lane of
+        the envelope this run rides."""
+        ctab, ftab = self._tables(churn, schedule)
+        self._validate_capacity(ctab)
+        root = prng.root_key(seed)
+        st0 = _init(self.n, self.i, self.c)
+        with tracecount.engine_scope("member"):
+            if self.runtime_tables:
+                final, cur, done = self._go(
+                    root, st0,
+                    jax.tree.map(jnp.asarray, ctab),
+                    jax.tree.map(jnp.asarray, ftab),
+                )
+            else:
+                final, cur, done = self._go(root, st0)
+        return ChurnResult(
+            state=final, rounds=int(final.t), done=bool(done),
+            injected=int(cur),
+        )
+
+    def run_host(self, seed: int = 0, churn=None, schedule=None) -> ChurnResult:
+        """The host-stepped twin: one jitted round per host-loop
+        iteration, the injection and termination decisions recomputed
+        each round from HOST-side numpy reads of the device state
+        (``_ready_host`` / ``_done_host``) — exactly the per-round
+        sync cost the device loop removes, and the honest baseline
+        ``bench_member_record`` times.  Decision-log byte-identical
+        to :meth:`run` on the same (churn, schedule, seed): the
+        predicates are the same formulas over the same state values
+        (pinned by tests/test_churn_table.py)."""
+        ctab, ftab = self._tables(churn, schedule)
+        self._validate_capacity(ctab)
+        root = prng.root_key(seed)
+        st = _init(self.n, self.i, self.c)
+        budget = self.max_rounds + int(ftab.horizon)
+        cur = 0
+        done = False
+        ftab_d = jax.tree.map(jnp.asarray, ftab)
+        with tracecount.engine_scope("member"):
+            while not done and int(st.t) < budget:
+                if _ready_host(ctab, cur, st):
+                    via = int(ctab.via[cur])
+                    pos = int(st.tail[via])
+                    st = st._replace(
+                        pend=st.pend.at[via, pos].set(int(ctab.vid[cur])),
+                        tail=st.tail.at[via].add(1),
+                    )
+                    cur += 1
+                st = (
+                    self._step(root, st, ftab_d) if self.runtime_tables
+                    else self._step(root, st)
+                )
+                done = _done_host(ctab, cur, st)
+        return ChurnResult(
+            state=st, rounds=int(st.t), done=done, injected=cur,
+        )
 
 
 class MemberSim:
@@ -813,22 +1375,10 @@ class MemberSim:
         self.root = prng.root_key(seed)
         self.state = _init(n_nodes, n_instances, self.c)
         self.schedule = schedule  # FaultSchedule | None (core/faults.py)
-        if schedule is not None and any(
-            e.kind == "crash" for e in schedule.episodes
-        ):
-            # deterministic crash points are a general-engine feature;
-            # this engine's crash model is the host-driven i.i.d. one
-            # (its round body never reads the compiled crash rows, so
-            # accepting them would silently ignore the fault)
-            raise ValueError(
-                "membership engine does not support crash episodes; "
-                "use crash_rate"
-            )
+        _check_member_schedule(schedule)
         comp = fltm.compile_schedule(schedule, n_nodes)
         self._round = jax.jit(
-            _build_round(
-                n_nodes, n_instances, self.c, self.root, crash_rate, comp
-            )
+            _build_round(n_nodes, n_instances, self.c, crash_rate, comp)
         )
         # Injection log: every (round, op, args) a host driver feeds
         # in.  The engine itself is a pure function of (seed, round),
@@ -853,6 +1403,9 @@ class MemberSim:
         }
         self.injections: list[list] = []
         self.crash_rate = crash_rate
+        self._sched_crashes = schedule is not None and any(
+            e.kind == "crash" for e in schedule.episodes
+        )
         # Round at which each node's CURRENT crash was observed — the
         # rejoin guard ties a checkpoint to this epoch, or a stale
         # snapshot from an earlier crash of the same node could roll
@@ -984,14 +1537,19 @@ class MemberSim:
 
     def _run_rounds(self, k: int) -> None:
         for _ in range(k):
-            self.state = self._round(self.state)
-            if self.crash_rate:
-                # engine-injected crashes don't pass through crash();
-                # observe them so the rejoin epoch guard stays sound
-                # (deterministic: the schedule is a function of
-                # (seed, round), so replays see the same rounds)
-                for nn in np.flatnonzero(np.asarray(self.state.crashed)):
-                    self._crash_round.setdefault(int(nn), int(self.state.t))
+            self.state = self._round(self.root, self.state)
+        if self.crash_rate or self._sched_crashes:
+            # Engine-injected crashes don't pass through crash();
+            # observe them so the rejoin epoch guard stays sound.
+            # Observed ONCE per stepping call, not per round (the
+            # PR-2-baselined per-round sync is gone): a host can only
+            # checkpoint between run_rounds calls, so stamping the
+            # block-end round is indistinguishable from the exact
+            # crash round for every snapshot a host can actually take
+            # — and only conservative (later stamp = stricter epoch
+            # guard) for hand-crafted ones.
+            for nn in np.flatnonzero(np.asarray(self.state.crashed)):
+                self._crash_round.setdefault(int(nn), int(self.state.t))
         # Capacity proof holds at runtime: the conflict-requeue scatter
         # (mode="drop") must never have been pushed past the ring.
         if int(np.max(np.asarray(self.state.tail))) > self.c:
@@ -1025,10 +1583,7 @@ class MemberSim:
         """Real (non-noop, non-change) values node has applied, in
         order — what the reference's checking StateMachine collects
         (ref member/main.cpp:223-233)."""
-        st = self.state
-        upto = int(st.applied_upto[node])
-        col = np.asarray(st.learned[:upto, node])
-        return col[(col >= 0) & (col < CHANGE_BASE)]
+        return applied_log_of(self.state, node)
 
     def crashed_set(self) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.crashed)).tolist())
@@ -1266,18 +1821,7 @@ class MemberSim:
         per instance plus each node's applied log — the byte-compare
         surface for record-vs-replay (mirrors member/diff.sh diffing
         two runs' logs)."""
-        st = self.state
-        cv = np.asarray(st.chosen_vid)
-        cr = np.asarray(st.chosen_round)
-        cb = np.asarray(st.chosen_ballot)
-        lines = [
-            f"[{i}] = <{cv[i]}>@{cr[i]}#{cb[i]}"
-            for i in np.flatnonzero(cv != int(val.NONE))
-        ]
-        for node in range(self.n):
-            seq = " ".join(map(str, self.applied_log(node).tolist()))
-            lines.append(f"applied[{node}] = {seq}")
-        return "\n".join(lines) + "\n"
+        return decision_log_of(self.state)
 
     def learner_set(self, viewer: int = 0) -> set[int]:
         return set(np.flatnonzero(np.asarray(self.state.learners[viewer])).tolist())
@@ -1286,9 +1830,11 @@ class MemberSim:
 # ---------------- IR-audit registration (analysis/jaxpr_audit) ------
 
 def audit_entries():
-    """Canonical trace of the membership round (analysis/registry.py):
-    crash_rate on, so the crash-admission sampling is in the traced
-    program the op budget pins."""
+    """Canonical traces of the membership engine (analysis/registry.py):
+    the single host-stepped round (crash_rate on, so the
+    crash-admission sampling is in the traced program the op budget
+    pins), the schedule-bearing replay round, the churn-table device
+    step, and the device-resident whole-run driver."""
     from tpu_paxos.analysis.registry import AuditEntry
 
     def build():
@@ -1296,8 +1842,8 @@ def audit_entries():
         c = i * 2 + 8
         root = prng.root_key(0)
         state = _init(n, i, c)
-        fn = _build_round(n, i, c, root, crash_rate=500, comp=None)
-        return fn, (state,)
+        fn = _build_round(n, i, c, crash_rate=500, comp=None)
+        return fn, (root, state)
 
     def build_replay():
         # The replay() configuration (the PR-3 follow-on ROADMAP item
@@ -1305,9 +1851,10 @@ def audit_entries():
         # with the RECORDED fault schedule, so the round it steps is
         # the schedule-bearing build — compiled reach/pause tables as
         # baked constants (what IR205's const budget watches here),
-        # the heal-horizon clamp, and the paused-receiver drops all in
-        # the traced program.  A regression in this trace is a replay
-        # that diverges from its recording.
+        # the heal-horizon clamp, the paused-receiver drops, and (new)
+        # the cumulative crash-point rows all in the traced program.
+        # A regression in this trace is a replay that diverges from
+        # its recording.
         from tpu_paxos.core import faults as fltm
 
         n, i = 3, 8
@@ -1315,15 +1862,75 @@ def audit_entries():
         sched = fltm.FaultSchedule((
             fltm.partition(2, 10, (0,), (1, 2)),
             fltm.pause(4, 9, 1),
+            fltm.crash(6, 2),
         ))
         comp = fltm.compile_schedule(sched, n)
         root = prng.root_key(0)
         state = _init(n, i, c)
-        fn = _build_round(n, i, c, root, crash_rate=500, comp=comp)
-        return fn, (state,)
+        fn = _build_round(n, i, c, crash_rate=500, comp=comp)
+        return fn, (root, state)
+
+    def _small_tables():
+        from tpu_paxos.core import faults as fltm
+        from tpu_paxos.fleet import schedule_table as stm
+
+        n = 3
+        churn = ctm.ChurnSchedule((
+            ctm.ChurnEvent(vid=100),
+            ctm.ChurnEvent(
+                vid=change_vid(1, ADD_ACCEPTOR), wait=ctm.WAIT_CHOSEN
+            ),
+            ctm.ChurnEvent(vid=101, wait=ctm.WAIT_APPLIED),
+        ))
+        sched = fltm.FaultSchedule((
+            fltm.pause(2, 5, 1), fltm.crash(8, 2),
+        ))
+        ctab = jax.tree.map(jnp.asarray, ctm.encode_churn(churn, n, 4))
+        ftab = jax.tree.map(
+            jnp.asarray, stm.encode_schedule(sched, n, 2)
+        )
+        return n, ctab, ftab
+
+    def build_churn_table():
+        # The churn-table device kernel in isolation: the injection
+        # gate (wait predicates over chosen/applied), the guarded
+        # pending-ring scatter, and the cond-gated run-complete
+        # reduction — the per-round cost every churn lane pays rides
+        # in THIS program, so its op budget is the knob that keeps
+        # table evaluation from outgrowing the round body.
+        n, i = 3, 8
+        c = i * 2 + 8
+        _, ctab, _ = _small_tables()
+        state = _init(n, i, c)
+
+        def fn(ctab, cursor, st):
+            st2, cur2 = _churn_inject(ctab, cursor, st, c)
+            return st2, cur2, _churn_done(ctab, cur2, st2)
+
+        return fn, (ctab, jnp.int32(0), state)
+
+    def build_run_loop():
+        # The device-resident whole-run driver (the sim._run_loop
+        # analog): runtime churn + fault tables through the
+        # while_loop, injection and termination inside the traced
+        # step.  IR201 is the load-bearing contract — NO host
+        # transfers in the loop body; that is the whole point of the
+        # driver.
+        n, i = 3, 8
+        eng = ChurnEngine(
+            n, i, runtime_tables=True, max_events=4, max_episodes=2,
+            crash_rate=500, max_rounds=64,
+        )
+        _, ctab, ftab = _small_tables()
+        root = prng.root_key(0)
+        state = _init(n, i, eng.c)
+        return eng._go, (root, state, ctab, ftab)
 
     return [
         AuditEntry("member.round", build,
                    covers=("MemberSim.__init__",)),
         AuditEntry("member.round_replay", build_replay),
+        AuditEntry("member.churn_table", build_churn_table),
+        AuditEntry("member.run_loop", build_run_loop,
+                   covers=("ChurnEngine.__init__",), hlo_golden=True),
     ]
