@@ -118,6 +118,58 @@ def test_fleet_record_withholds_implausible_rate():
     assert rec["raw_timings_s"] == [0.0, 0.0, 0.0]
 
 
+def test_geo_record_publishes_per_preset_rates():
+    # two presets, 8 lanes of ~1 MiB state over >= 30 rounds: fine
+    rec = bench._geo_record(
+        {"wan-3region": [0.10, 0.11, 0.12],
+         "wan-5region": [0.20, 0.21, 0.22]},
+        8 << 20, 30, 8, 1, 0, [], {"devices": 1},
+    )
+    assert rec["value"]["wan-3region"] == pytest.approx(8 / 0.11, abs=0.005)
+    assert rec["value"]["wan-5region"] == pytest.approx(8 / 0.21, abs=0.005)
+    assert rec["warm_compiles_across_presets"] == 0
+    assert rec["unit"] == "lanes/sec"
+
+
+def test_geo_record_withholds_on_warm_compiles():
+    """The record's claim IS the shared executable: any compile after
+    the first preset withholds the whole record, plausible timings or
+    not."""
+    rec = bench._geo_record(
+        {"wan-3region": [0.10, 0.11, 0.12],
+         "wan-5region": [0.20, 0.21, 0.22]},
+        8 << 20, 30, 8, 1, 2, [], {"devices": 1},
+    )
+    assert "error" in rec and "one-envelope-executable" in rec["error"]
+    assert "value" not in rec
+    assert rec["raw_timings_s"]["wan-3region"] == [0.10, 0.11, 0.12]
+
+
+def test_geo_record_withholds_on_parity_failure():
+    """A scalar-vs-uniform-matrix or fleet-vs-single-run mismatch is
+    a forked fault model — the record is withheld NAMING the
+    failure, like _serve_record's p99-mismatch withhold."""
+    rec = bench._geo_record(
+        {"wan-3region": [0.10, 0.11, 0.12]},
+        8 << 20, 30, 8, 1, 0,
+        ["scalar knobs != uniform-matrix twin (sha parity)"],
+        {"devices": 1},
+    )
+    assert "error" in rec and "parity withheld" in rec["error"]
+    assert "sha parity" in rec["error"]
+    assert "value" not in rec
+
+
+def test_geo_record_withholds_implausible_rate():
+    rec = bench._geo_record(
+        {"wan-3region": [1e-6, 2e-6, 3e-6]},
+        1 << 30, 1000, 64, 1, 0, [], {"devices": 1},
+    )
+    assert "error" in rec and "roofline" in rec["error"]
+    assert "wan-3region" in rec["error"]
+    assert "value" not in rec
+
+
 def test_serve_record_publishes_plausible_rate():
     # ~1 MiB of loop state over >= 100 rounds in ~0.5 s: fine
     pts = [{"rate_milli": 4000, "p99": 30, "sustained": True}]

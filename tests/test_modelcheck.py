@@ -226,7 +226,7 @@ def test_mc_cli_exits_2_on_scope_errors(tmp_path):
 def test_crash_episode_tables_and_compiled_rows():
     e = flt.crash(4, 1)
     assert (e.t0, e.t1, e.nodes) == (4, 5, (1,))
-    cut, paused, extra, cmask = flt.episode_tables(e, 3)
+    cut, paused, extra, cmask, _gray = flt.episode_tables(e, 3)
     assert not cut.any() and not paused.any() and extra == 0
     assert cmask.tolist() == [False, True, False]
     with pytest.raises(ValueError, match="t0 \\+ 1"):
@@ -257,7 +257,7 @@ def test_crashes_at_matches_compiled_rows():
         got = np.asarray(stm.crashes_at(tab, t))
         assert (got == want).all(), t
         # the existing three masks stay untouched by crash letters
-        reach, paused, extra = stm.masks_at(tab, t)
+        reach, paused, extra, _gray = stm.masks_at(tab, t)
         assert np.asarray(reach).all()
 
 

@@ -17,16 +17,18 @@ def _assert_masks_match(sched, n_nodes, pad=None, extra_rounds=4):
     horizon = comp.horizon if comp is not None else 0
     assert int(tab.horizon) == horizon
     for t in range(horizon + extra_rounds):
-        reach, paused, extra = stm.masks_at(tab, t)
+        reach, paused, extra, gray = stm.masks_at(tab, t)
         if comp is None:
             assert np.asarray(reach).all()
             assert not np.asarray(paused).any()
             assert int(extra) == 0
+            assert not np.asarray(gray).any()
             continue
         tt = min(t, horizon)
         assert (np.asarray(reach) == comp.reach[tt]).all(), f"reach @ t={t}"
         assert (np.asarray(paused) == comp.paused[tt]).all(), f"paused @ t={t}"
         assert int(extra) == int(comp.extra_drop[tt]), f"extra @ t={t}"
+        assert (np.asarray(gray) == comp.gray[tt]).all(), f"gray @ t={t}"
 
 
 @pytest.mark.parametrize(
@@ -49,11 +51,24 @@ def test_every_kind_with_padding():
         flt.one_way(3, 12, (0, 4), (1,)),
         flt.pause(1, 7, 3),
         flt.burst(4, 10, 2500),
+        flt.gray(3, 11, 2, delay=2),
     ))
     _assert_masks_match(sched, 5)
     # a larger episode capacity pads with never-active slots — masks
     # unchanged
     _assert_masks_match(sched, 5, pad=8)
+
+
+def test_overlapping_gray_inflations_add():
+    sched = flt.FaultSchedule((
+        flt.gray(0, 10, 1, delay=2),
+        flt.gray(5, 15, 1, 2, delay=3),
+    ))
+    _assert_masks_match(sched, 3)
+    tab = stm.encode_schedule(sched, 3)
+    _, _, _, gray = stm.masks_at(tab, 7)
+    # node 1 carries both episodes (2 + 3), node 2 only the second
+    assert np.asarray(gray).tolist() == [0, 5, 3]
 
 
 def test_empty_schedule_is_all_clear():
@@ -73,7 +88,7 @@ def test_touching_intervals():
     ))
     _assert_masks_match(sched, 3)
     tab = stm.encode_schedule(sched, 3)
-    reach, _, _ = stm.masks_at(tab, 5)
+    reach, _, _, _ = stm.masks_at(tab, 5)
     reach = np.asarray(reach)
     assert reach[0, 1] and reach[1, 0]  # first episode healed
     assert not reach[0, 2] and not reach[1, 2]  # second active
@@ -86,7 +101,7 @@ def test_full_mesh_partition():
     ))
     _assert_masks_match(sched, 5)
     tab = stm.encode_schedule(sched, 5)
-    reach, _, _ = stm.masks_at(tab, 3)
+    reach, _, _, _ = stm.masks_at(tab, 3)
     assert (np.asarray(reach) == np.eye(5, dtype=bool)).all()
 
 
@@ -97,7 +112,7 @@ def test_overlapping_bursts_add_and_clamp():
     ))
     _assert_masks_match(sched, 3)
     tab = stm.encode_schedule(sched, 3)
-    _, _, extra = stm.masks_at(tab, 7)
+    _, _, extra, _ = stm.masks_at(tab, 7)
     assert int(extra) == 10_000  # 12000 clamps like the compiled path
 
 
@@ -107,7 +122,7 @@ def test_one_way_self_edge_never_cut():
     sched = flt.FaultSchedule((flt.one_way(0, 5, (0, 1), (0, 2)),))
     _assert_masks_match(sched, 3)
     tab = stm.encode_schedule(sched, 3)
-    reach, _, _ = stm.masks_at(tab, 2)
+    reach, _, _, _ = stm.masks_at(tab, 2)
     assert np.asarray(reach).diagonal().all()
 
 
@@ -127,7 +142,7 @@ def test_encode_batch_stacks_independent_lanes():
                                   for f in stm.ScheduleTable._fields))
         comp = flt.compile_schedule(s, 3)
         for t in range(10):
-            reach, paused, extra = stm.masks_at(one, t)
+            reach, paused, extra, _ = stm.masks_at(one, t)
             if comp is None:
                 assert np.asarray(reach).all() and int(extra) == 0
             else:

@@ -105,6 +105,12 @@ def _mk_summary(**over):
         takeover_round=np.asarray([7, -1], np.int32),
         rounds=np.int32(34),
         quiescent=np.bool_(True),
+        region_offered=np.zeros(
+            (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
+        ),
+        region_dropped=np.zeros(
+            (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
+        ),
     )
     base.update(over)
     return telem.TelemetrySummary(**base)

@@ -111,6 +111,12 @@ def test_summary_and_reduce_lanes_windows_integration():
         stall_max=np.int32(3), duel_max=np.int32(4),
         takeover_round=np.asarray([7, -1], np.int32),
         rounds=np.int32(34), quiescent=np.bool_(True),
+        region_offered=np.zeros(
+            (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
+        ),
+        region_dropped=np.zeros(
+            (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
+        ),
     )
     s = telem.TelemetrySummary(**base)
     assert "windows" not in telem.summary_to_dict(s)

@@ -24,7 +24,7 @@ ARTIFACT_FORMAT = "tpu-paxos-repro-1"
 
 _SHA256_HEX = frozenset("0123456789abcdef")
 
-EPISODE_KINDS = ("partition", "one_way", "pause", "burst", "crash")
+EPISODE_KINDS = ("partition", "one_way", "pause", "burst", "crash", "gray")
 
 
 class ArtifactSchemaError(ValueError):
@@ -130,6 +130,12 @@ class Any:
         pass
 
 
+class Bool:
+    def check(self, v, at):
+        if not isinstance(v, bool):
+            raise ArtifactSchemaError(at, f"expected bool, got {_tn(v)}")
+
+
 class Sha256Hex:
     def check(self, v, at):
         Str().check(v, at)
@@ -173,6 +179,7 @@ _EPISODE = Obj({
     "dst": ListOf(Int()),
     "nodes": ListOf(Int()),
     "drop_rate": Int(min=0),
+    "delay": Int(min=0),  # gray: per-message delay inflation rounds
 }, required=("kind", "t0", "t1"), extra_ok=False)
 
 _SCHEDULE = Obj(
@@ -189,6 +196,16 @@ _PROTOCOL = Obj({
     "commit_retry_timeout": Int(min=0),
 }, extra_ok=False)
 
+# Per-edge [A, A] fault tables (config.EdgeFaultConfig): four square
+# int matrices; squareness/range/min<=max are revalidated semantically
+# by the config constructors on load — the schema names the field.
+_EDGES = Obj({
+    "drop_rate": ListOf(ListOf(Int(min=0))),
+    "dup_rate": ListOf(ListOf(Int(min=0))),
+    "min_delay": ListOf(ListOf(Int(min=0))),
+    "max_delay": ListOf(ListOf(Int(min=0))),
+}, extra_ok=False)
+
 _FAULTS = Obj({
     "drop_rate": Int(min=0),
     "dup_rate": Int(min=0),
@@ -196,7 +213,14 @@ _FAULTS = Obj({
     "max_delay": Int(min=0),
     "crash_rate": Int(min=0),
     "schedule": Nullable(_SCHEDULE),
-}, extra_ok=False)
+    # WAN fields (written only when non-default — hence OPTIONAL, so
+    # classic artifacts validate unchanged)
+    "edges": Nullable(_EDGES),
+    "delivery_cut": Bool(),
+}, required=(
+    "drop_rate", "dup_rate", "min_delay", "max_delay", "crash_rate",
+    "schedule",
+), extra_ok=False)
 
 _CFG = Obj({
     "n_nodes": Int(min=1),

@@ -224,6 +224,19 @@ class McScope:
         bad = sorted(set(self.kinds) - set(fltm.KINDS))
         if bad:
             raise ScopeError(f"unknown episode kind(s): {', '.join(bad)}")
+        if "gray" in self.kinds:
+            # NAMED rejection, never silent exclusion: the letter
+            # builder below has no gray axis yet (a gray letter needs
+            # a (nodes x delay-tier) grid and its own symmetry
+            # story), so a scope declaring it must fail loudly rather
+            # than certify a universe it silently never enumerated.
+            raise ScopeError(
+                "gray episodes are not enumerable by this checker yet: "
+                "remove 'gray' from kinds (the stress WAN mixes and "
+                "the fleet search's --gray grammar cover gray "
+                "failures; an exhaustive gray scope needs a delay-"
+                "tier axis in the codec)"
+            )
         if "burst" in self.kinds and not self.burst_rates:
             raise ScopeError("burst in kinds needs burst_rates")
         for r in self.burst_rates:
@@ -309,7 +322,7 @@ def _table_key(e: fltm.Episode, n_nodes: int) -> tuple:
     masks the engine actually sees (faults.episode_tables).  Two
     grammar spellings with equal masks — e.g. a partition group and
     its complement — are the same letter."""
-    cut, paused, extra, crash_m = fltm.episode_tables(e, n_nodes)
+    cut, paused, extra, crash_m, _gray = fltm.episode_tables(e, n_nodes)
     return (
         e.t0, e.t1, cut.tobytes(), paused.tobytes(), int(extra),
         crash_m.tobytes(),
@@ -1051,6 +1064,7 @@ def audit_entries():
             roots, states, tabs,
             jax.tree.map(jnp.asarray, kn),
             jnp.asarray(exp), jnp.asarray(own),
+            jnp.zeros((len(scenarios), cfg.n_nodes), jnp.int32),
         )
 
     return [

@@ -62,6 +62,96 @@ class ProtocolConfig:
                 raise ValueError(f"{name} must be >= 1")
 
 
+def _matrix(field: str, m, n: int | None) -> tuple:
+    """Canonicalize one per-edge table to a square tuple-of-tuples of
+    ints; ``n`` (if known) pins the side length."""
+    rows = tuple(tuple(int(x) for x in row) for row in m)
+    side = len(rows)
+    if n is not None and side != n:
+        raise ValueError(f"{field} must be {n}x{n}, got {side} rows")
+    for r in rows:
+        if len(r) != side:
+            raise ValueError(f"{field} must be square ({side}x{side})")
+    return rows
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeFaultConfig:
+    """Per-edge ``[A, A]`` i.i.d. fault tables — the WAN-shaped
+    generalization of the scalar THNetWork knobs.  Entry ``[s][d]``
+    governs messages from node ``s`` to node ``d``: drop/dup rates
+    per 1e4 and a uniform delay span in rounds, all free to be
+    asymmetric.  A uniform matrix is bit-identical to the equivalent
+    scalar knobs (the exact-at-zero masked-sampling contract,
+    core/net.py — sha256 parity pinned by tests/test_geo.py), so
+    every scalar config is the degenerate case of this model.
+
+    Plain tuples of ints: hashable, JSON-serializable (repro
+    artifacts), and structurally comparable like every other config
+    dataclass."""
+
+    drop_rate: tuple  # [A][A] per 10_000
+    dup_rate: tuple  # [A][A] per 10_000
+    min_delay: tuple  # [A][A] rounds
+    max_delay: tuple  # [A][A] rounds
+
+    def __post_init__(self) -> None:
+        d = _matrix("edges.drop_rate", self.drop_rate, None)
+        n = len(d)
+        if n < 1:
+            raise ValueError("edges tables must name at least one node")
+        object.__setattr__(self, "drop_rate", d)
+        for f in ("dup_rate", "min_delay", "max_delay"):
+            object.__setattr__(self, f, _matrix(f"edges.{f}", getattr(self, f), n))
+        for f in ("drop_rate", "dup_rate"):
+            for row in getattr(self, f):
+                for v in row:
+                    if not 0 <= v <= 10_000:
+                        raise ValueError(f"edges.{f} must be in [0, 10000]")
+        for s in range(n):
+            for t in range(n):
+                lo, hi = self.min_delay[s][t], self.max_delay[s][t]
+                if lo < 0 or lo > hi:
+                    raise ValueError(
+                        f"edges delay span [{lo}, {hi}] on edge "
+                        f"{s}->{t} must satisfy 0 <= min <= max"
+                    )
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.drop_rate)
+
+    @property
+    def delay_bound(self) -> int:
+        """Largest per-edge max_delay — the ring bound this matrix
+        needs."""
+        return max(max(row) for row in self.max_delay)
+
+    @classmethod
+    def uniform(cls, n_nodes: int, drop_rate: int = 0, dup_rate: int = 0,
+                min_delay: int = 0, max_delay: int = 0) -> "EdgeFaultConfig":
+        """The uniform matrix equivalent of scalar knobs (the sha256
+        parity anchor)."""
+        def full(v):
+            return tuple((int(v),) * n_nodes for _ in range(n_nodes))
+
+        return cls(full(drop_rate), full(dup_rate), full(min_delay),
+                   full(max_delay))
+
+    def to_dict(self) -> dict:
+        return {
+            f: [list(r) for r in getattr(self, f)]
+            for f in ("drop_rate", "dup_rate", "min_delay", "max_delay")
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EdgeFaultConfig":
+        return cls(**{
+            f: tuple(tuple(r) for r in d[f])
+            for f in ("drop_rate", "dup_rate", "min_delay", "max_delay")
+        })
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultConfig:
     """Network fault injection, THNetWork semantics.
@@ -87,8 +177,23 @@ class FaultConfig:
     crash_rate: int = 0  # per 1_000_000
     # Correlated-fault layer on top of the i.i.d. knobs above: a
     # deterministic schedule of partition / one-way-cut / pause /
-    # burst-loss episodes (core/faults.py).  None = no episodes.
+    # burst-loss / crash-point / gray episodes (core/faults.py).
+    # None = no episodes.
     schedule: FaultSchedule | None = None
+    # Per-edge [A, A] drop/dup/delay tables (WAN topologies,
+    # asymmetric loss).  When set, the tables REPLACE the scalar
+    # drop/dup/min_delay knobs (which must stay 0 — one unambiguous
+    # source of truth) and the scalar ``max_delay`` becomes the RING
+    # BOUND: it must cover every per-edge max_delay (the arrival
+    # calendars are statically sized to ``max_delay + 2`` slots).
+    edges: EdgeFaultConfig | None = None
+    # Delivery-time partition semantics (the PR-1 follow-on): with
+    # True, in-flight copies whose edge is cut on their ARRIVAL round
+    # are dropped at the partition edge (same-side copies deliver
+    # untouched).  Default False keeps the send-time-only semantics
+    # every existing schedule, artifact, and certificate was recorded
+    # under — it is a compile-time engine flag, not a runtime knob.
+    delivery_cut: bool = False
 
     def __post_init__(self) -> None:
         if not 0 <= self.drop_rate <= 10_000:
@@ -105,6 +210,35 @@ class FaultConfig:
             self.schedule, FaultSchedule
         ):
             raise TypeError("schedule must be a FaultSchedule or None")
+        if (
+            self.schedule is not None
+            and self.max_delay == 0
+            and any(e.kind == "gray" for e in self.schedule.episodes)
+        ):
+            # NAMED rejection, never silent exclusion (the mc-scope /
+            # membership discipline): gray inflation clamps at the
+            # ring bound, so at max_delay=0 every gray episode would
+            # be a complete no-op — the user would believe they
+            # verified gray behavior when no fault was injected
+            raise ValueError(
+                "gray episodes need a nonzero ring bound: with "
+                "max_delay=0 the delay-inflation clamp reduces every "
+                "gray episode to a no-op (set max_delay to the delay "
+                "headroom gray messages may use)"
+            )
+        if self.edges is not None:
+            if not isinstance(self.edges, EdgeFaultConfig):
+                raise TypeError("edges must be an EdgeFaultConfig or None")
+            if self.drop_rate or self.dup_rate or self.min_delay:
+                raise ValueError(
+                    "edges tables replace the scalar drop/dup/delay "
+                    "knobs; keep drop_rate/dup_rate/min_delay at 0"
+                )
+            if self.edges.delay_bound > self.max_delay:
+                raise ValueError(
+                    f"edges max_delay {self.edges.delay_bound} exceeds "
+                    f"the ring bound max_delay={self.max_delay}"
+                )
 
     @property
     def is_reliable(self) -> bool:
@@ -113,6 +247,7 @@ class FaultConfig:
             and self.min_delay == 0
             and self.max_delay == 0
             and self.crash_rate == 0
+            and self.edges is None
             and (self.schedule is None or not self.schedule.episodes)
         )
 
@@ -151,6 +286,15 @@ class SimConfig:
         for p in self.proposers:
             if not 0 <= p < self.n_nodes:
                 raise ValueError(f"proposer {p} out of range")
+        if (
+            self.faults.edges is not None
+            and self.faults.edges.n_nodes != self.n_nodes
+        ):
+            raise ValueError(
+                f"faults.edges is {self.faults.edges.n_nodes}x"
+                f"{self.faults.edges.n_nodes} but the cluster has "
+                f"{self.n_nodes} nodes"
+            )
 
     @property
     def quorum(self) -> int:

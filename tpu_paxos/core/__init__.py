@@ -11,7 +11,7 @@ blocks for minutes on instance-metadata fetches).
 
 _SUBMODULES = (
     "apply", "ballot", "fast", "fastwin", "faults", "net", "sim",
-    "simkern", "values",
+    "simkern", "values", "wan",
 )
 
 

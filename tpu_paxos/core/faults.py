@@ -27,17 +27,30 @@ windows.  This module adds that layer as a ``FaultSchedule`` of
   contract's crash excusals apply exactly as for sampled crashes.
   This is the model checker's deterministic crash axis
   (analysis/modelcheck.py): a (node, round) grid instead of a rate.
+- ``gray(t0, t1, *nodes, delay=k)`` — GRAY FAILURE: the nodes are
+  *slow*, not dead.  Every message a gray node sends or receives
+  while the episode is active has ``k`` extra rounds added to its
+  sampled delay (sums along an edge when both ends are gray, and
+  across overlapping gray episodes), clamped at the engine's arrival
+  ring bound (``cfg.faults.max_delay``) — gray NEVER drops a
+  message, which is exactly what makes gray failures invisible to
+  crash- and pause-shaped detectors.  Like a pause the node heals at
+  ``t1`` with its state intact; unlike a pause it keeps acting every
+  round, just at WAN-shaped latency.
 
 Episodes compose: overlapping cuts AND their reachability, pauses OR,
-burst rates add, crash sets union (and stay crashed forever).  ``compile_schedule`` lowers a schedule into dense
-per-round tables — ``reach [H+1, N, N]``, ``paused [H+1, N]``,
-``extra_drop [H+1]`` with row ``H`` (the horizon = last episode end)
-fully healed — which the engines index with ``min(t, H)``; one gather
-per round, fully jit/shard_map-compatible, composing with the
-THNetWork-style sampling in ``core/net.py`` at *send* time (a message
-sent while its edge is cut is lost at the sender's NIC; copies
-already in flight still deliver — a schedule the i.i.d. drop fault
-already contains).
+burst rates add, crash sets union (and stay crashed forever), gray
+inflations ADD per node.  ``compile_schedule`` lowers a schedule into
+dense per-round tables — ``reach [H+1, N, N]``, ``paused [H+1, N]``,
+``extra_drop [H+1]``, ``gray [H+1, N]`` with row ``H`` (the horizon =
+last episode end) fully healed — which the engines index with
+``min(t, H)``; one gather per round, fully jit/shard_map-compatible,
+composing with the THNetWork-style sampling in ``core/net.py`` at
+*send* time (a message sent while its edge is cut is lost at the
+sender's NIC; copies already in flight still deliver by default — a
+schedule the i.i.d. drop fault already contains — unless the config
+arms ``delivery_cut``, which additionally drops in-flight copies AT
+the partition edge on their arrival round).
 
 Liveness contract (enforced by the engines): paused nodes are excused
 only *while* paused, and quiescence is never declared before the last
@@ -57,7 +70,7 @@ from typing import NamedTuple
 
 import numpy as np
 
-KINDS = ("partition", "one_way", "pause", "burst", "crash")
+KINDS = ("partition", "one_way", "pause", "burst", "crash", "gray")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,8 +83,9 @@ class Episode:
     groups: tuple[tuple[int, ...], ...] = ()  # partition
     src: tuple[int, ...] = ()  # one_way
     dst: tuple[int, ...] = ()  # one_way
-    nodes: tuple[int, ...] = ()  # pause
+    nodes: tuple[int, ...] = ()  # pause / crash / gray
     drop_rate: int = 0  # burst, per 1e4, added to FaultConfig.drop_rate
+    delay: int = 0  # gray, extra delay rounds per affected message
 
     def __post_init__(self) -> None:
         if self.kind not in KINDS:
@@ -110,6 +124,11 @@ class Episode:
                 raise ValueError(
                     "crash episodes are instants: t1 must be t0 + 1"
                 )
+        if self.kind == "gray":
+            if not self.nodes:
+                raise ValueError("gray needs at least one node")
+            if self.delay < 1:
+                raise ValueError("gray delay must be >= 1 round")
 
     def shifted(self, t0: int, t1: int) -> "Episode":
         """Same episode over a different interval (the shrinker's
@@ -150,6 +169,13 @@ def crash(t0: int, *nodes) -> Episode:
     """Deterministic crash point: ``nodes`` fail-stop at the end of
     round ``t0`` and never return (module doc)."""
     return Episode("crash", t0, t0 + 1, nodes=tuple(nodes))
+
+
+def gray(t0: int, t1: int, *nodes, delay: int = 2) -> Episode:
+    """Gray failure: ``nodes`` are slow during [t0, t1) — ``delay``
+    extra rounds on every message they send or receive, clamped at
+    the ring bound, never dropped (module doc)."""
+    return Episode("gray", t0, t1, nodes=tuple(nodes), delay=delay)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -206,6 +232,7 @@ class FaultSchedule:
                     dst=tuple(e.get("dst", ())),
                     nodes=tuple(e.get("nodes", ())),
                     drop_rate=e.get("drop_rate", 0),
+                    delay=e.get("delay", 0),
                 )
             )
         return cls(tuple(eps))
@@ -225,11 +252,13 @@ class CompiledSchedule(NamedTuple):
     paused: np.ndarray  # [H+1, N] bool
     extra_drop: np.ndarray  # [H+1] int32, additional per-1e4 drop rate
     crashed: np.ndarray  # [H+1, N] bool, cumulative scheduled crashes
+    gray: np.ndarray  # [H+1, N] int32, per-node extra delay rounds
     horizon: int
     has_reach: bool
     has_pause: bool
     has_burst: bool
     has_crash: bool
+    has_gray: bool
 
 
 def validate_episode(e: Episode, n_nodes: int) -> None:
@@ -256,16 +285,18 @@ def validate_episode(e: Episode, n_nodes: int) -> None:
 def episode_tables(e: Episode, n_nodes: int):
     """Static per-episode masks — the single source of truth both
     lowerings share: ``(cut [N, N] bool, paused [N] bool, extra_drop
-    int, crash [N] bool)`` where ``cut[s, d]`` means the s->d edge is
-    severed while the episode is active and ``crash`` names the nodes
-    a crash point fail-stops (active from ``t0`` FOREVER — crashes
-    never heal).  The diagonal is never cut (a node always reaches
-    itself).  Only the episode's own dimension is non-trivial; the
-    others return zeros."""
+    int, crash [N] bool, gray [N] int32)`` where ``cut[s, d]`` means
+    the s->d edge is severed while the episode is active, ``crash``
+    names the nodes a crash point fail-stops (active from ``t0``
+    FOREVER — crashes never heal), and ``gray`` is the per-node extra
+    delay a gray episode inflicts while active.  The diagonal is
+    never cut (a node always reaches itself).  Only the episode's own
+    dimension is non-trivial; the others return zeros."""
     validate_episode(e, n_nodes)
     cut = np.zeros((n_nodes, n_nodes), bool)
     paused = np.zeros((n_nodes,), bool)
     crash_m = np.zeros((n_nodes,), bool)
+    gray_v = np.zeros((n_nodes,), np.int32)
     extra = 0
     if e.kind == "partition":
         group_of = np.full((n_nodes,), len(e.groups), np.int32)
@@ -281,7 +312,9 @@ def episode_tables(e: Episode, n_nodes: int):
         extra = e.drop_rate
     elif e.kind == "crash":
         crash_m[list(e.nodes)] = True
-    return cut, paused, extra, crash_m
+    elif e.kind == "gray":
+        gray_v[list(e.nodes)] = e.delay
+    return cut, paused, extra, crash_m, gray_v
 
 
 def compile_schedule(
@@ -297,12 +330,14 @@ def compile_schedule(
     paused = np.zeros((h + 1, n_nodes), bool)
     extra = np.zeros((h + 1,), np.int64)
     crashed = np.zeros((h + 1, n_nodes), bool)
+    gray_t = np.zeros((h + 1, n_nodes), np.int64)
     for e in sched.episodes:
         rows = slice(e.t0, e.t1)  # t1 <= h, so row h stays healed
-        cut, pmask, xd, cmask = episode_tables(e, n_nodes)
+        cut, pmask, xd, cmask, gv = episode_tables(e, n_nodes)
         reach[rows] &= ~cut[None]
         paused[rows] |= pmask[None]
         extra[rows] += xd
+        gray_t[rows] += gv[None]
         # crash points are permanent: from t0 through row h inclusive,
         # so the engines' min(t, horizon) read never un-crashes a node
         crashed[e.t0:] |= cmask[None]
@@ -312,9 +347,13 @@ def compile_schedule(
         paused=paused,
         extra_drop=np.minimum(extra, 10_000).astype(np.int32),
         crashed=crashed,
+        # uncapped sum here; the engines clamp the INFLATED delay at
+        # the ring bound, which also bounds any overlapping-gray sum
+        gray=np.minimum(gray_t, np.iinfo(np.int32).max).astype(np.int32),
         horizon=h,
         has_reach=any(e.kind in ("partition", "one_way") for e in sched.episodes),
         has_pause=any(e.kind == "pause" for e in sched.episodes),
         has_burst=any(e.kind == "burst" for e in sched.episodes),
         has_crash=any(e.kind == "crash" for e in sched.episodes),
+        has_gray=any(e.kind == "gray" for e in sched.episodes),
     )

@@ -64,6 +64,19 @@ class FaultKnobs(NamedTuple):
     (or ``[lanes]`` vectors under the fleet vmap) instead of
     compile-time constants baked into the engine closure.
 
+    The first four fields may also be per-edge ``[A, A]`` int32
+    MATRICES (``[lanes, A, A]`` under the fleet vmap) — the WAN
+    generalization: entry ``[s, d]`` governs node ``s`` -> node ``d``
+    messages.  ``copy_plan`` samples the same PRNG bits either way
+    (bits depend only on key/shape/dtype) and applies the rates/spans
+    elementwise, so a UNIFORM matrix draws bit-identically to the
+    scalar knob — the parity contract that makes every scalar config
+    the degenerate case of the matrix model (tests/test_geo.py pins
+    the decision-log sha256).  Matrix knobs must be pre-sliced to the
+    edge shape before they reach ``copy_plan`` (``edge_knobs``).
+    ``crash_rate`` stays a scalar: crashes are per-node, not
+    per-edge.
+
     This is what makes ONE compiled executable cover every stress
     mix: ``copy_plan`` with ``knobs=`` samples in always-on masked
     form — ``randint(.., 0, 10000) < rate`` is all-false at rate 0
@@ -87,18 +100,83 @@ class FaultKnobs(NamedTuple):
     min_delay: jax.Array  # int32 rounds
     max_delay: jax.Array  # int32 rounds, <= the engine's envelope bound
     crash_rate: jax.Array  # int32, per 1e6 (member/ RandomFailure)
+    delay_bound: jax.Array  # int32 scalar: the CONFIG's declared
+    #     max_delay (the lane's own ring headroom) — the gray-failure
+    #     inflation clamp.  A runtime knob, NOT the engine's static
+    #     ring size: the fleet envelope's ring may be wider than the
+    #     lane's declared bound, and clamping at the engine bound
+    #     would make gray delays depend on which executable ran the
+    #     lane — a decision-visible fork between a fleet lane and its
+    #     lane_cfg() single-run replay (caught by review; pinned by
+    #     tests/test_geo.py's min_delay-bearing gray parity cell).
 
 
 def knobs_from_faults(fc: FaultConfig) -> FaultKnobs:
     """Host-side encoding of a FaultConfig's i.i.d. knobs (the
     schedule is NOT part of the knobs — it rides the runtime
-    ScheduleTable, fleet/schedule_table.py)."""
+    ScheduleTable, fleet/schedule_table.py).  An ``edges``-bearing
+    config encodes to matrix-form knobs (``matrix_knobs``)."""
+    if fc.edges is not None:
+        return matrix_knobs(fc)
     return FaultKnobs(
         drop_rate=np.int32(fc.drop_rate),
         dup_rate=np.int32(fc.dup_rate),
         min_delay=np.int32(fc.min_delay),
         max_delay=np.int32(fc.max_delay),
         crash_rate=np.int32(fc.crash_rate),
+        delay_bound=np.int32(fc.max_delay),
+    )
+
+
+def matrix_knobs(fc: FaultConfig, n_nodes: int | None = None) -> FaultKnobs:
+    """Matrix-form host knobs for ``fc``: its ``edges`` tables when
+    present, else the scalar knobs broadcast to a UNIFORM ``[A, A]``
+    matrix (bit-identical to the scalar path — the FaultKnobs parity
+    contract).  ``n_nodes`` is required for the uniform broadcast of
+    an edge-free config."""
+    e = fc.edges
+    if e is not None:
+        return FaultKnobs(
+            drop_rate=np.asarray(e.drop_rate, np.int32),
+            dup_rate=np.asarray(e.dup_rate, np.int32),
+            min_delay=np.asarray(e.min_delay, np.int32),
+            max_delay=np.asarray(e.max_delay, np.int32),
+            crash_rate=np.int32(fc.crash_rate),
+            delay_bound=np.int32(fc.max_delay),
+        )
+    if n_nodes is None:
+        raise ValueError("matrix_knobs needs n_nodes for an edge-free config")
+    full = lambda v: np.full((n_nodes, n_nodes), v, np.int32)  # noqa: E731
+    return FaultKnobs(
+        drop_rate=full(fc.drop_rate),
+        dup_rate=full(fc.dup_rate),
+        min_delay=full(fc.min_delay),
+        max_delay=full(fc.max_delay),
+        crash_rate=np.int32(fc.crash_rate),
+        delay_bound=np.int32(fc.max_delay),
+    )
+
+
+def edge_knobs(knobs: FaultKnobs, rows, cols) -> FaultKnobs:
+    """Slice matrix-form knob fields to one edge shape: ``rows`` are
+    the source node ids of the edge-shape's leading axis, ``cols``
+    the destination ids of its trailing axis (e.g. proposer->node
+    sends slice ``[pn, :]``; node->proposer replies ``[:, pn]``).
+    Scalar fields pass through untouched, so the helper is a no-op
+    view for scalar knobs and mixing forms per field is legal."""
+    import jax.numpy as jnp
+
+    def sl(x):
+        x = jnp.asarray(x)
+        return x if x.ndim < 2 else x[rows][:, cols]
+
+    return FaultKnobs(
+        drop_rate=sl(knobs.drop_rate),
+        dup_rate=sl(knobs.dup_rate),
+        min_delay=sl(knobs.min_delay),
+        max_delay=sl(knobs.max_delay),
+        crash_rate=knobs.crash_rate,
+        delay_bound=knobs.delay_bound,
     )
 
 
@@ -164,6 +242,8 @@ def copy_plan(
     fc: FaultConfig,
     extra_drop=None,
     knobs: FaultKnobs | None = None,
+    gray=None,
+    delay_bound: int | None = None,
 ):
     """Sample the THNetWork fault plan for one broadcast/send.
 
@@ -184,9 +264,33 @@ def copy_plan(
     :class:`FaultKnobs` instead of ``fc`` and every branch runs in
     its always-on masked form — exact when a knob is zero (see the
     FaultKnobs docstring for the parity argument), so one executable
-    serves every knob mix.
+    serves every knob mix.  Knob fields pre-sliced to ``edge_shape``
+    (``edge_knobs``) give per-EDGE rates/spans: the drawn bits are
+    identical, the compares/arithmetic elementwise, so a uniform
+    matrix is bit-identical to the scalar knob.
+
+    ``gray`` (``[*edge_shape]`` int32, or None) is the fault
+    schedule's gray-failure inflation for this round: extra delay
+    rounds ADDED to every surviving copy's sampled delay, clamped at
+    the CONFIG's declared delay bound — ``knobs.delay_bound`` (a
+    traced per-lane scalar) on the knobs path, the static
+    ``delay_bound`` (= ``fc.max_delay``) otherwise.  The clamp must
+    NOT be the engine's ring size: a fleet envelope's ring is wider
+    than a lane's declared bound, and clamping there would fork the
+    lane from its single-run replay.  Gray never drops — the clamp
+    is the contract (tests/test_geo.py): an all-zero gray round is
+    exact (``min(d + 0, bound) == d`` for every in-bound sample).
     """
     k_drop, k_dup, k_delay = jax.random.split(key, 3)
+
+    def _gray(delay):
+        if gray is None:
+            return delay
+        if knobs is not None:
+            bound = jnp.asarray(knobs.delay_bound, jnp.int32)
+        else:
+            bound = jnp.int32(int(delay_bound))
+        return jnp.minimum(delay + gray[None], bound)
     if knobs is not None:
         rate = jnp.asarray(knobs.drop_rate, jnp.int32)
         if extra_drop is not None:
@@ -209,7 +313,16 @@ def copy_plan(
             jnp.asarray(knobs.max_delay, jnp.int32) + 1,
             dtype=jnp.int32,
         )
-        return alive, delay
+        return alive, _gray(delay)
+    if fc.edges is not None:
+        # trace-time guard: an edges-bearing config must arrive via
+        # the masked knobs path (matrix_knobs) — the scalar branches
+        # below would silently sample its zeroed scalar knobs
+        raise ValueError(
+            "copy_plan with per-edge tables needs knobs= "
+            "(net.matrix_knobs); the static scalar path would drop "
+            "the matrix"
+        )
     if extra_drop is not None:
         rate = jnp.minimum(jnp.int32(fc.drop_rate) + extra_drop, 10_000)
         drop = jax.random.randint(k_drop, edge_shape, 0, 10_000) < rate
@@ -230,7 +343,7 @@ def copy_plan(
     else:
         dups = jnp.zeros((MAX_COPIES - 1, *edge_shape), jnp.bool_)
     alive = jnp.concatenate([(~drop)[None], dups], axis=0)
-    if fc.max_delay:
+    if fc.max_delay and fc.edges is None:
         delay = jax.random.randint(
             k_delay,
             (MAX_COPIES, *edge_shape),
@@ -239,8 +352,31 @@ def copy_plan(
             dtype=jnp.int32,
         )
     else:
+        # edges-bearing configs never reach this branch (the engine
+        # routes them through the masked knobs path with the matrix
+        # baked in as a constant); a delay-free config samples 0
         delay = jnp.zeros((MAX_COPIES, *edge_shape), jnp.int32)
-    return alive, delay
+    return alive, _gray(delay)
+
+
+def delivery_mask(ar: NetBuffers, reach_pa, reach_ap) -> NetBuffers:
+    """Delivery-time partition cut: void the popped arrival slot's
+    entries on edges severed at the ARRIVAL round (``reach_pa`` is
+    the [P, A] proposer->node reachability, ``reach_ap`` its [A, P]
+    node->proposer transpose view).  Same-side arrivals pass through
+    untouched, and an all-true reach round is the identity — the
+    exactness anchor for cut-free schedules.  Armed by
+    ``FaultConfig.delivery_cut`` (a compile-time engine flag); the
+    default send-time-only semantics leave in-flight copies alone."""
+    return NetBuffers(
+        prep_req=jnp.where(reach_pa, ar.prep_req, bal.NONE),
+        prep_echo=jnp.where(reach_ap, ar.prep_echo, bal.NONE),
+        rej=jnp.where(reach_ap, ar.rej, bal.NONE),
+        acc_req=jnp.where(reach_pa, ar.acc_req, bal.NONE),
+        acc_echo=jnp.where(reach_ap, ar.acc_echo, bal.NONE),
+        com_pres=ar.com_pres & reach_pa,
+        com_rep=ar.com_rep & reach_ap,
+    )
 
 
 def _slot_onehot(t, s: int, alive, delay):

@@ -534,6 +534,33 @@ def build_engine(
         if comp is not None and comp.has_crash
         else None
     )
+    gray_tab = (
+        jnp.asarray(comp.gray)
+        if comp is not None and comp.has_gray
+        else None
+    )
+    # Per-edge [A, A] fault tables (cfg.faults.edges) ride the masked
+    # knobs-path sampling with the matrices baked in as compile-time
+    # CONSTANTS — the scalar branches in copy_plan cannot express
+    # per-edge rates.  Bit-identical to the scalar path for a uniform
+    # matrix (the FaultKnobs parity contract, tests/test_geo.py).
+    if runtime_knobs and fc.edges is not None:
+        raise ValueError(
+            "runtime_knobs engines take their knobs per call (matrix "
+            "or scalar FaultKnobs); cfg.faults.edges must be None"
+        )
+    static_mknobs = (
+        jax.tree.map(jnp.asarray, netm.matrix_knobs(fc))
+        if fc.edges is not None else None
+    )
+    # Delivery-time partition cut (FaultConfig.delivery_cut): a
+    # compile-time flag — armed engines void in-flight arrivals on
+    # edges cut at the DELIVERY round.  Meaningful only where reach
+    # masks exist: the constant path elides it for cut-free schedules
+    # (identical program), the runtime path arms it whenever the flag
+    # is set (exact for cut-free tables: an all-true reach round is
+    # the identity).
+    delivery_cut = bool(fc.delivery_cut)
     # Scheduled crash points (or a runtime table that may carry them)
     # mean `crashed` can change without any i.i.d. draw — the
     # crash-coupled cached blocks (commit-ack refresh, quiescence
@@ -648,9 +675,9 @@ def build_engine(
             # (fleet/schedule_table.masks_at) — same composition
             # semantics as the constant rows below, so the two paths
             # are decision-log-identical for the same schedule.  All
-            # four dimensions are live (the table's content, not its
+            # five dimensions are live (the table's content, not its
             # shape, says which episodes exist).
-            reach_t, paused_t, xdrop_t = _stm.masks_at(tab, t)
+            reach_t, paused_t, xdrop_t, gray_t = _stm.masks_at(tab, t)
             crash_t = _stm.crashes_at(tab, t)  # [A]
         else:
             # Fault-schedule tables for this round (min(t, horizon):
@@ -666,6 +693,7 @@ def build_engine(
             reach_t = reach_tab[tt] if reach_tab is not None else None
             xdrop_t = drop_tab[tt] if drop_tab is not None else None  # int32
             crash_t = crash_tab[tt] if crash_tab is not None else None  # [A]
+            gray_t = gray_tab[tt] if gray_tab is not None else None  # [A]
 
         # I/O-alive mask: crashed OR currently paused nodes neither
         # send, receive, nor act on timers this round.  Excusals
@@ -679,9 +707,18 @@ def build_engine(
 
         # Per-edge reachability cuts ANDed into every send mask below
         # (send-time semantics: copies already in the calendars still
-        # deliver — a schedule the i.i.d. drop fault already contains).
+        # deliver — a schedule the i.i.d. drop fault already contains
+        # — unless delivery_cut is armed, below).
         reach_pa = reach_t[pn] if reach_t is not None else None  # [P, A]
         reach_ap = reach_t[:, pn] if reach_t is not None else None  # [A, P]
+
+        if delivery_cut and reach_pa is not None:
+            # Delivery-time cut (the PR-1 follow-on): in-flight copies
+            # whose edge is severed on their ARRIVAL round are dropped
+            # at the partition edge; same-side copies deliver
+            # untouched (net.delivery_mask — exact for cut-free
+            # rounds, where reach is all-true).
+            ar = netm.delivery_mask(ar, reach_pa, reach_ap)
 
         def _cut_pa(m):  # [P, A] proposer->node send mask through cuts
             return m if reach_pa is None else m & reach_pa
@@ -689,10 +726,32 @@ def build_engine(
         def _cut_ap(m):  # [A, P] node->proposer send mask through cuts
             return m if reach_ap is None else m & reach_ap
 
-        def _plan(key, edge_shape):
+        # Sampling knobs: per-call traced (runtime_knobs) or the
+        # compile-time constant matrices of an edges-bearing config.
+        # Matrix fields are sliced to each direction's edge shape
+        # (net.edge_knobs — a no-op passthrough for scalar fields, so
+        # the scalar runtime-knob program is unchanged); gray delay
+        # inflation composes per edge as src + dst slowness, clamped
+        # at the ring bound inside copy_plan.
+        kn_eff = knobs if runtime_knobs else static_mknobs
+        if kn_eff is not None:
+            aidx_n = jnp.arange(a)
+            kn_pa = netm.edge_knobs(kn_eff, pn, aidx_n)
+            kn_ap = netm.edge_knobs(kn_eff, aidx_n, pn)
+        else:
+            kn_pa = kn_ap = None
+        if gray_t is not None:
+            gray_pa = gray_t[pn][:, None] + gray_t[None, :]  # [P, A]
+            gray_ap = gray_t[:, None] + gray_t[pn][None, :]  # [A, P]
+        else:
+            gray_pa = gray_ap = None
+
+        def _plan(key, edge_shape, pa):
             return netm.copy_plan(
                 key, edge_shape, fc, extra_drop=xdrop_t,
-                knobs=knobs if runtime_knobs else None,
+                knobs=kn_pa if pa else kn_ap,
+                gray=gray_pa if pa else gray_ap,
+                delay_bound=fc.max_delay,
             )
 
         keys = jax.random.split(prng.stream(root, prng.STREAM_NET_DROP, t), 8)
@@ -1540,32 +1599,32 @@ def build_engine(
         # pair also feeds the recorder's fault-layer counters
         # (_tsites) — reading values already computed, never sampling.
         edge_pa = (p, a)
-        _tsites = []  # [(alive, delay, post-cut mask)] in MSG order
+        _tsites = []  # [(alive, delay, post-cut mask, is_pa)] in MSG order
         # prepare requests
-        al, dl = _plan(keys[0], edge_pa)
+        al, dl = _plan(keys[0], edge_pa, True)
         m_prep = _cut_pa(send_prep[:, None] & jnp.ones((p, a), jnp.bool_))
-        _tsites.append((al, dl, m_prep))
+        _tsites.append((al, dl, m_prep, True))
         net = net._replace(
             prep_req=netm.write_ballot(
                 net.prep_req, t, al, dl, ballot[:, None], m_prep
             )
         )
         # prepare replies (granted only; snapshot read at delivery)
-        al, dl = _plan(keys[1], (a, p))
+        al, dl = _plan(keys[1], (a, p), False)
         send_rep = grant.T  # [A, P]
         echo_val = preq.T  # [A, P] the granted ballot
         m_rep = _cut_ap(send_rep)
-        _tsites.append((al, dl, m_rep))
+        _tsites.append((al, dl, m_rep, False))
         net = net._replace(
             prep_echo=netm.write_ballot(
                 net.prep_echo, t, al, dl, echo_val, m_rep
             )
         )
         # rejects (both phases share one message, ref MSG_REJECT)
-        al, dl = _plan(keys[2], (a, p))
+        al, dl = _plan(keys[2], (a, p), False)
         send_rej = (rej_prep | rej_acc).T
         m_rej = _cut_ap(send_rej)
-        _tsites.append((al, dl, m_rej))
+        _tsites.append((al, dl, m_rej, False))
         net = net._replace(
             rej=netm.write_ballot(
                 net.rej, t, al, dl,
@@ -1574,20 +1633,20 @@ def build_engine(
             )
         )
         # accepts: per-edge ballot (batch content read at delivery)
-        al, dl = _plan(keys[3], edge_pa)
+        al, dl = _plan(keys[3], edge_pa, True)
         m_acc = _cut_pa(send_accept[:, None] & jnp.ones((p, a), jnp.bool_))
-        _tsites.append((al, dl, m_acc))
+        _tsites.append((al, dl, m_acc, True))
         net = net._replace(
             acc_req=netm.write_ballot(
                 net.acc_req, t, al, dl, ballot[:, None], m_acc
             )
         )
         # accept replies (ack rows derived at delivery)
-        al, dl = _plan(keys[4], (a, p))
+        al, dl = _plan(keys[4], (a, p), False)
         send_arep = elig.T  # [A, P] reply whenever ballot >= promised
         aecho_val = jnp.broadcast_to(abal[None, :], (a, p))
         m_arep = _cut_ap(send_arep)
-        _tsites.append((al, dl, m_arep))
+        _tsites.append((al, dl, m_arep, False))
         net = net._replace(
             acc_echo=netm.write_ballot(
                 net.acc_echo, t, al, dl, aecho_val, m_arep
@@ -1595,17 +1654,17 @@ def build_engine(
         )
         # commits: per-edge presence (content read at delivery from
         # the sender's write-once commit_vid)
-        al, dl = _plan(keys[5], edge_pa)
+        al, dl = _plan(keys[5], edge_pa, True)
         m_com = _cut_pa(send_commit[:, None] & jnp.ones((p, a), jnp.bool_))
-        _tsites.append((al, dl, m_com))
+        _tsites.append((al, dl, m_com, True))
         net = net._replace(
             com_pres=netm.write_flag(net.com_pres, t, al, dl, m_com)
         )
         # commit replies: presence; ack-by-learned-match at delivery
-        al, dl = _plan(keys[6], (a, p))
+        al, dl = _plan(keys[6], (a, p), False)
         send_crep = cpres.T  # [A, P]
         m_crep = _cut_ap(send_crep)
-        _tsites.append((al, dl, m_crep))
+        _tsites.append((al, dl, m_crep, False))
         net = net._replace(
             com_rep=netm.write_flag(net.com_rep, t, al, dl, m_crep)
         )
@@ -1792,7 +1851,32 @@ def build_engine(
         # state, so the armed engine stays decision-log-identical.
         if _ww:
             tele, wins = tele  # windowed builds carry the pair
-        tc = [_rec.count_copies(al_, dl_, m_) for (al_, dl_, m_) in _tsites]
+        tc = [
+            _rec.count_copies(al_, dl_, m_) for (al_, dl_, m_, _pa) in _tsites
+        ]
+        # Per-edge offered/dropped breakdown (the WAN plane): the
+        # already-computed copy plans and post-cut masks, summed per
+        # direction and scattered into the [A, A] accumulators via
+        # the proposer->node map (pn rows are distinct nodes, so the
+        # two scatters never collide within themselves).
+        aidx_t = jnp.arange(a)
+        off_pa = drop_pa = jnp.zeros((p, a), jnp.int32)
+        off_ap = drop_ap = jnp.zeros((a, p), jnp.int32)
+        for (al_, _dl_, m_, is_pa) in _tsites:
+            offc = m_.astype(jnp.int32)
+            drpc = (m_ & ~al_[0]).astype(jnp.int32)
+            if is_pa:
+                off_pa = off_pa + offc
+                drop_pa = drop_pa + drpc
+            else:
+                off_ap = off_ap + offc
+                drop_ap = drop_ap + drpc
+        edge_off = tele.edge_offered.at[pn[:, None], aidx_t[None, :]].add(
+            off_pa
+        ).at[aidx_t[:, None], pn[None, :]].add(off_ap)
+        edge_drp = tele.edge_dropped.at[pn[:, None], aidx_t[None, :]].add(
+            drop_pa
+        ).at[aidx_t[:, None], pn[None, :]].add(drop_ap)
         cv_new = (commit_vid != val.NONE) & (pr.commit_vid == val.NONE)
         took = cv_new & ~newly  # [P, I] commit-takeover adoptions
         took_p = jnp.any(took, axis=1)  # [P]
@@ -1818,6 +1902,8 @@ def build_engine(
                 t, tele.takeover_round,
             ),
             stall_max=jnp.maximum(tele.stall_max, jnp.max(stall)),
+            edge_offered=edge_off,
+            edge_dropped=edge_drp,
         )
         if not _ww:
             return new_st, new_tele
@@ -2035,7 +2121,9 @@ def _run_loop_knobs(cfg: SimConfig, round_fn):
     return _go
 
 
-def _run_loop_telemetry(cfg: SimConfig, round_fn, window_rounds: int = 0):
+def _run_loop_telemetry(
+    cfg: SimConfig, round_fn, window_rounds: int = 0, region_map=None
+):
     """Whole-run driver for a ``telemetry=True`` engine: the loop
     carries ``(state, Telemetry)`` and the epilogue reduces the
     recorder to its fixed-shape :class:`TelemetrySummary` INSIDE the
@@ -2051,6 +2139,14 @@ def _run_loop_telemetry(cfg: SimConfig, round_fn, window_rounds: int = 0):
     sched = cfg.faults.schedule
     horizon = sched.horizon if sched is not None else 0
     ww = int(window_rounds)
+    # node->region assignment for the per-region-pair fault counters:
+    # a trace-time CONSTANT here (the single-run path compiles per
+    # cfg anyway; the fleet passes it as a runtime per-lane input).
+    # None traces the same program as an all-zero map.
+    rmap = (
+        None if region_map is None
+        else jnp.asarray(np.asarray(region_map, np.int32))
+    )
 
     @jax.jit
     def _go(root, state, tele):
@@ -2062,11 +2158,11 @@ def _run_loop_telemetry(cfg: SimConfig, round_fn, window_rounds: int = 0):
 
         final, tl = jax.lax.while_loop(cond, body, (state, tele))
         if not ww:
-            return final, telem.summarize(tl, final, horizon)
+            return final, telem.summarize(tl, final, horizon, rmap)
         base, wins = tl
         return (
             final,
-            telem.summarize(base, final, horizon),
+            telem.summarize(base, final, horizon, rmap),
             telem.summarize_windows(
                 wins, base.admit_round, final.met.chosen_vid,
                 final.met.chosen_round, ww,
@@ -2081,6 +2177,7 @@ def run_with_telemetry(
     workload: list[np.ndarray] | None = None,
     gates: list[np.ndarray] | None = None,
     window_rounds: int | None = None,
+    region_map=None,
 ):
     """``run()`` with the flight recorder armed: returns ``(SimResult,
     TelemetrySummary, WindowSummary | None)`` (summary fields as host
@@ -2107,8 +2204,10 @@ def run_with_telemetry(
         cfg, c, vid_cap=gates_vid_cap(workload, gates), telemetry=True,
         window_rounds=ww,
     )
-    _go = _run_loop_telemetry(cfg, round_fn, window_rounds=ww)
-    tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+    _go = _run_loop_telemetry(
+        cfg, round_fn, window_rounds=ww, region_map=region_map
+    )
+    tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers), cfg.n_nodes)
     if ww:
         tele0 = (tele0, telem.init_windows())
     with tracecount.engine_scope("sim"):
@@ -2272,7 +2371,7 @@ def audit_entries():
         root = prng.root_key(cfg.seed)
         state = init_state(cfg, pend, gate, tail, root)
         rf = build_engine(cfg, c, vid_cap=0, telemetry=True)
-        tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+        tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers), cfg.n_nodes)
         return _run_loop_telemetry(cfg, rf), (root, state, tele0)
 
     def build_timeseries():
@@ -2305,7 +2404,7 @@ def audit_entries():
             cfg, c, vid_cap=0, telemetry=True, window_rounds=ww
         )
         tele0 = (
-            telem.init_telemetry(cfg.n_instances, len(cfg.proposers)),
+            telem.init_telemetry(cfg.n_instances, len(cfg.proposers), cfg.n_nodes),
             telem.init_windows(),
         )
         return (

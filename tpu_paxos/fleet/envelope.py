@@ -89,6 +89,7 @@ def envelope_key(
     )
     return (
         bool(telemetry),
+        bool(cfg.faults.delivery_cut),  # compile-time engine flag
         simm.seeded_wedge(),
         cfg.n_nodes,
         cfg.proposers,
@@ -146,7 +147,10 @@ def runner_for(
     runner = _CACHE.get(key)
     if runner is None:
         base = dataclasses.replace(
-            cfg, seed=0, faults=FaultConfig(max_delay=delay_bound)
+            cfg, seed=0, faults=FaultConfig(
+                max_delay=delay_bound,
+                delivery_cut=cfg.faults.delivery_cut,
+            )
         )
         runner = frun.FleetRunner(
             base, workload, gates, mesh=mesh, max_episodes=max_episodes,

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_paxos.analysis import tracecount
-from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.config import EdgeFaultConfig, FaultConfig, SimConfig
 from tpu_paxos.core import net as netm
 from tpu_paxos.core import sim as simm
 from tpu_paxos.core import values as val
@@ -216,7 +216,7 @@ class FleetRunner:
         if telemetry:
             from tpu_paxos.telemetry import recorder as telem
 
-            def lane(root, st, tab, kn, exp, own):
+            def lane(root, st, tab, kn, exp, own, rmap):
                 def cond(c):
                     return (~c[0].done) & (
                         c[0].t < cfg.max_rounds + tab.horizon
@@ -229,7 +229,7 @@ class FleetRunner:
                 # traced program, shared by every armed consumer)
                 tele0 = (
                     telem.init_telemetry(
-                        cfg.n_instances, len(cfg.proposers)
+                        cfg.n_instances, len(cfg.proposers), cfg.n_nodes
                     ),
                     telem.init_windows(),
                 )
@@ -241,7 +241,7 @@ class FleetRunner:
                 return (
                     final,
                     vdt.lane_verdict(cfg, final, exp, own, vid_cap=vid_bound),
-                    telem.summarize(tl, final, tab.horizon),
+                    telem.summarize(tl, final, tab.horizon, rmap),
                     telem.summarize_windows(
                         ws, tl.admit_round, final.met.chosen_vid,
                         final.met.chosen_round, telem.WINDOW_ROUNDS,
@@ -268,7 +268,7 @@ class FleetRunner:
             spec = P(pmesh.instance_axes(mesh))
             fl = pmesh.shard_map(
                 fl, mesh,
-                in_specs=(spec,) * 6,
+                in_specs=(spec,) * (7 if telemetry else 6),
                 out_specs=(spec,) * (4 if telemetry else 2),
             )
         self._fn = jax.jit(fl)
@@ -360,26 +360,48 @@ class FleetRunner:
     def _knob_arrays(self, n_lanes: int, knobs):
         """[lanes]-stacked ``FaultKnobs`` plus the per-lane
         (schedule-free) FaultConfig list — the shrink hand-off's
-        ``lane_cfg`` source.  ``knobs[i]`` may be a FaultConfig or a
-        host FaultKnobs; None defaults every lane to the base cfg's
-        i.i.d. knobs."""
+        ``lane_cfg`` source.  ``knobs[i]`` may be a FaultConfig (edge
+        matrices welcome) or a host FaultKnobs (scalar or matrix
+        form); None defaults every lane to the base cfg's i.i.d.
+        knobs.
+
+        Every lane NORMALIZES to matrix form (``net.matrix_knobs``:
+        scalar knobs become a uniform ``[A, A]`` matrix, bit-identical
+        by the FaultKnobs parity contract), so ONE compiled executable
+        covers scalar mixes and WAN topologies alike — per-edge
+        tables are just another runtime input of the envelope."""
         if knobs is None:
             knobs = [self.cfg.faults] * n_lanes
         knobs = list(knobs)
         if len(knobs) != n_lanes:
             raise ValueError("one knob set per lane required")
+        a = self.cfg.n_nodes
         fcs = []
         for k in knobs:
             if isinstance(k, netm.FaultKnobs):
                 # routes through FaultConfig validation (rate ranges,
-                # min <= max)
-                k = FaultConfig(
-                    drop_rate=int(k.drop_rate),
-                    dup_rate=int(k.dup_rate),
-                    min_delay=int(k.min_delay),
-                    max_delay=int(k.max_delay),
-                    crash_rate=int(k.crash_rate),
-                )
+                # min <= max — per edge for matrix-form knobs)
+                if np.ndim(k.drop_rate) >= 2:
+                    # EdgeFaultConfig canonicalizes the (host numpy)
+                    # rows to int tuples itself
+                    k = FaultConfig(
+                        max_delay=int(np.max(k.max_delay)),
+                        crash_rate=int(k.crash_rate),
+                        edges=EdgeFaultConfig(
+                            drop_rate=k.drop_rate,
+                            dup_rate=k.dup_rate,
+                            min_delay=k.min_delay,
+                            max_delay=k.max_delay,
+                        ),
+                    )
+                else:
+                    k = FaultConfig(
+                        drop_rate=int(k.drop_rate),
+                        dup_rate=int(k.dup_rate),
+                        min_delay=int(k.min_delay),
+                        max_delay=int(k.max_delay),
+                        crash_rate=int(k.crash_rate),
+                    )
             if not isinstance(k, FaultConfig):
                 raise TypeError(
                     f"per-lane knobs must be FaultConfig or FaultKnobs, "
@@ -396,13 +418,26 @@ class FleetRunner:
                     f"envelope's ring bound {self.delay_bound} "
                     "(cfg.faults.max_delay)"
                 )
+            if k.delivery_cut != self.cfg.faults.delivery_cut:
+                raise ValueError(
+                    "delivery_cut is a compile-time engine flag: every "
+                    f"lane must match the runner's build "
+                    f"({self.cfg.faults.delivery_cut}); build a "
+                    "separate runner for the other semantics"
+                )
             fcs.append(k)
+        mats = [netm.matrix_knobs(fc, a) for fc in fcs]
         stacked = netm.FaultKnobs(
-            drop_rate=np.asarray([fc.drop_rate for fc in fcs], np.int32),
-            dup_rate=np.asarray([fc.dup_rate for fc in fcs], np.int32),
-            min_delay=np.asarray([fc.min_delay for fc in fcs], np.int32),
-            max_delay=np.asarray([fc.max_delay for fc in fcs], np.int32),
+            drop_rate=np.stack([m.drop_rate for m in mats]),
+            dup_rate=np.stack([m.dup_rate for m in mats]),
+            min_delay=np.stack([m.min_delay for m in mats]),
+            max_delay=np.stack([m.max_delay for m in mats]),
             crash_rate=np.asarray([fc.crash_rate for fc in fcs], np.int32),
+            # the gray clamp is each lane's OWN declared bound (what
+            # lane_cfg() replays single-run), never the envelope ring
+            delay_bound=np.asarray(
+                [fc.max_delay for fc in fcs], np.int32
+            ),
         )
         return stacked, fcs
 
@@ -412,14 +447,20 @@ class FleetRunner:
         schedules,
         workloads=None,
         knobs=None,
+        regions=None,
     ) -> FleetReport:
         """One fleet dispatch: ``seeds[i]``, ``schedules[i]``
         (FaultSchedule or None), and ``knobs[i]`` (FaultConfig /
-        FaultKnobs or None for the base cfg's mix) drive lane ``i``;
-        ``workloads`` optionally carries per-lane ``(workload,
-        gates)`` pairs (template-shaped; vid sets free within the
-        envelope's vid bound).  Returns once the verdict vector is on
-        the host; the per-lane states stay on device.
+        FaultKnobs or None for the base cfg's mix — per-edge matrix
+        configs welcome: every lane normalizes to matrix form) drive
+        lane ``i``; ``workloads`` optionally carries per-lane
+        ``(workload, gates)`` pairs (template-shaped; vid sets free
+        within the envelope's vid bound); ``regions`` (telemetry
+        runners only) optionally carries per-lane ``[A]`` int32
+        node->region maps for the recorder's per-region-pair fault
+        counters (None = all-zero maps — same executable).  Returns
+        once the verdict vector is on the host; the per-lane states
+        stay on device.
 
         Runners from the envelope cache (``fleet/envelope.runner_for``)
         REJECT ``workloads=None`` / ``knobs=None``: the cached
@@ -450,10 +491,43 @@ class FleetRunner:
             ),
         )
         kn, fault_cfgs = self._knob_arrays(n_lanes, knobs)
+        # NAMED rejection, never silent exclusion (the FaultConfig
+        # compile-time check's runtime-table twin): a gray episode on
+        # a lane whose declared bound is 0 would clamp to a no-op
+        for i, (fc_i, s_i) in enumerate(zip(fault_cfgs, schedules)):
+            if (
+                fc_i.max_delay == 0
+                and s_i is not None
+                and any(e.kind == "gray" for e in s_i.episodes)
+            ):
+                raise ValueError(
+                    f"lane {i}: gray episodes need a nonzero lane "
+                    "max_delay (the delay-inflation clamp is the "
+                    "lane's own declared bound; at 0 every gray "
+                    "episode is a no-op)"
+                )
         roots = jnp.stack([prng.root_key(s) for s in seeds])
         pend, gate, tail, exp, own, exp_list = self._queues(
             n_lanes, workloads
         )
+        if regions is not None and not self.telemetry:
+            raise ValueError(
+                "regions maps feed the flight recorder's region-pair "
+                "counters; build the runner with telemetry=True"
+            )
+        if self.telemetry:
+            a = self.cfg.n_nodes
+            if regions is None:
+                rmaps = np.zeros((n_lanes, a), np.int32)
+            else:
+                regions = list(regions)
+                if len(regions) != n_lanes:
+                    raise ValueError("one region map per lane required")
+                rmaps = np.stack([
+                    np.zeros((a,), np.int32) if r is None
+                    else np.asarray(r, np.int32).reshape(a)
+                    for r in regions
+                ])
         t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         tsum = wsum = None
         with tracecount.engine_scope("fleet"):
@@ -461,11 +535,14 @@ class FleetRunner:
                 jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail),
                 roots,
             )
-            out = self._fn(
+            args = (
                 roots, states, tabs,
                 jax.tree.map(jnp.asarray, kn),
                 jnp.asarray(exp), jnp.asarray(own),
             )
+            if self.telemetry:
+                args = args + (jnp.asarray(rmaps),)
+            out = self._fn(*args)
             if self.telemetry:
                 final, v, tsum, wsum = out
             else:
@@ -519,25 +596,37 @@ def audit_entries():
         scheds = [
             fltm.FaultSchedule((fltm.partition(2, 6, (0,), (1, 2)),)),
             fltm.FaultSchedule((
-                fltm.pause(1, 4, 1), fltm.burst(2, 5, 1500),
+                fltm.pause(1, 4, 1), fltm.gray(2, 5, 2, delay=2),
             )),
         ]
         tabs = jax.tree.map(
             jnp.asarray, stm.encode_batch(scheds, cfg.n_nodes, 2)
         )
         roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
+        # one scalar mix + one per-edge WAN matrix: both normalize to
+        # [lanes, A, A] matrix knobs — the envelope's one program
+        from tpu_paxos.config import EdgeFaultConfig as _E
+
         kn, _ = runner._knob_arrays(
-            2, [cfg.faults, FaultConfig(dup_rate=1000, max_delay=1)]
+            2, [cfg.faults, FaultConfig(
+                max_delay=2,
+                edges=_E.uniform(cfg.n_nodes, dup_rate=1000, max_delay=1),
+            )]
         )
         pend, gate, tail, exp, own, _ = runner._queues(2, None)
         states = runner._init(
             jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), roots
         )
-        return runner._fn, (
+        args = (
             roots, states, tabs,
             jax.tree.map(jnp.asarray, kn),
             jnp.asarray(exp), jnp.asarray(own),
         )
+        if telemetry:
+            args = args + (
+                jnp.zeros((2, cfg.n_nodes), jnp.int32),
+            )
+        return runner._fn, args
 
     ir204_why = (
         "the vmapped lane body IS core/sim's round_fn — same "

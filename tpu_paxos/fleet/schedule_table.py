@@ -18,6 +18,7 @@ drop masks are computed INSIDE the traced step (:func:`masks_at`):
     reach     = ~any_e(active[e] & cut[e])        (diagonal never cut)
     paused    =  any_e(active[e] & paused[e])
     extra     =  min(sum_e(active[e] * drop[e]), 10000)
+    gray      =  sum_e(active[e] * gray[e])       (per-node delay add)
 
 plus the one-sided crash-point mask (:func:`crashes_at` — crash
 episodes are permanent, so their activity test is ``t0[e] <= t`` with
@@ -60,6 +61,7 @@ class ScheduleTable(NamedTuple):
     crash: np.ndarray  # [E, N] bool crash points (permanent from t0;
     #     padding slots are all-false, so the t0 <= t read in
     #     crashes_at stays inert for them)
+    gray: np.ndarray  # [E, N] int32 per-node extra delay while active
     horizon: np.ndarray  # [] int32 first round with every episode over
 
 
@@ -84,10 +86,11 @@ def encode_schedule(
     paused = np.zeros((e_cap, n_nodes), bool)
     extra = np.zeros((e_cap,), np.int32)
     crash = np.zeros((e_cap, n_nodes), bool)
+    gray = np.zeros((e_cap, n_nodes), np.int32)
     for i, e in enumerate(eps):
-        c, p, x, cm = fltm.episode_tables(e, n_nodes)
+        c, p, x, cm, gv = fltm.episode_tables(e, n_nodes)
         t0[i], t1[i] = e.t0, e.t1
-        cut[i], paused[i], extra[i], crash[i] = c, p, x, cm
+        cut[i], paused[i], extra[i], crash[i], gray[i] = c, p, x, cm, gv
     return ScheduleTable(
         t0=t0,
         t1=t1,
@@ -95,6 +98,7 @@ def encode_schedule(
         paused=paused,
         extra_drop=extra,
         crash=crash,
+        gray=gray,
         horizon=np.int32(sched.horizon if sched is not None else 0),
     )
 
@@ -122,9 +126,10 @@ def encode_batch(
 
 def masks_at(tab: ScheduleTable, t):
     """Per-round masks from a (traced) table: ``(reach [N, N] bool,
-    paused [N] bool, extra_drop int32)``.  Pure jnp — called inside
-    the engine's round function; composition semantics match
-    ``faults.compile_schedule`` row ``t`` exactly (module doc)."""
+    paused [N] bool, extra_drop int32, gray [N] int32)``.  Pure jnp —
+    called inside the engine's round function; composition semantics
+    match ``faults.compile_schedule`` row ``t`` exactly (module
+    doc)."""
     import jax.numpy as jnp
 
     t = jnp.asarray(t, jnp.int32)
@@ -135,7 +140,10 @@ def masks_at(tab: ScheduleTable, t):
         jnp.sum(jnp.where(active, tab.extra_drop, jnp.int32(0))),
         jnp.int32(10_000),
     )
-    return reach, paused, extra
+    gray = jnp.sum(
+        jnp.where(active[:, None], tab.gray, jnp.int32(0)), axis=0
+    )  # [N]; the engine clamps the inflated delay at its ring bound
+    return reach, paused, extra, gray
 
 
 def crashes_at(tab: ScheduleTable, t):

@@ -46,6 +46,22 @@ from tpu_paxos.core import faults as fltm
 
 KINDS = ("partition", "one_way", "pause", "burst", "crash")
 
+#: The WAN-extended grammar (``--gray``): gray failures join the draw
+#: alphabet.  Opt-in, NOT the default — adding a kind changes the
+#: seeded draw sequence, and the committed fleet-quick wedge artifact
+#: (and its trace golden) are pinned against the classic alphabet.
+KINDS_GRAY = KINDS + ("gray",)
+
+#: Gray-episode delay-inflation draw bound (rounds).  Inflated delays
+#: clamp at the envelope's ring bound inside the engine either way.
+GRAY_DELAY_MAX = 5
+
+#: Edge-matrix gene base-latency cap (``--wan``), matching the
+#: committed WAN presets' range (core/wan.py peaks at 4+1 jitter,
+#: which the stress WAN mixes prove convergent under the default
+#: retry ladder).
+GENE_LAT_MAX = 4
+
 #: Crash-point grid resolution: crash ``t0`` draws land on this many
 #: quantized slots across the first 3/4 of the horizon (the model
 #: checker's (node, round)-grid discipline, analysis/modelcheck.py —
@@ -56,6 +72,7 @@ CRASH_GRID = 8
 def sample_episode(
     rng: np.random.Generator, n_nodes: int, horizon: int,
     crashed=frozenset(),
+    kinds=KINDS,
 ) -> fltm.Episode:
     """One grammar draw: a kind, a jittered interval inside
     ``[0, horizon)``, and kind-specific random structure (groups /
@@ -72,7 +89,7 @@ def sample_episode(
     itself a deterministic function of the seeded draw history — the
     same seed always takes the same branch.  Don't compare draws
     across different ``crashed`` histories at one seed.)"""
-    kind = KINDS[int(rng.integers(len(KINDS)))]
+    kind = kinds[int(rng.integers(len(kinds)))]
     t0 = int(rng.integers(0, max(1, horizon - 6)))
     width = int(rng.integers(4, max(5, horizon // 2)))
     t1 = min(t0 + width, horizon)
@@ -108,6 +125,14 @@ def sample_episode(
         n_paused = int(rng.integers(1, max(2, n_nodes // 2 + 1)))
         nodes = rng.permutation(n_nodes)[:n_paused]
         return fltm.pause(t0, t1, *(int(x) for x in nodes))
+    if kind == "gray":
+        # gray failures may hit ANY number of nodes (they are slow,
+        # not dead — no quorum math caps the set), with a drawn
+        # per-message delay inflation
+        n_gray = int(rng.integers(1, n_nodes + 1))
+        nodes = rng.permutation(n_nodes)[:n_gray]
+        d = int(rng.integers(1, GRAY_DELAY_MAX + 1))
+        return fltm.gray(t0, t1, *(int(x) for x in nodes), delay=d)
     return fltm.burst(t0, t1, int(rng.integers(500, 6000)))
 
 
@@ -116,15 +141,60 @@ def sample_schedule(
     n_nodes: int,
     max_episodes: int = 4,
     horizon: int = 96,
+    kinds=KINDS,
 ) -> fltm.FaultSchedule:
     n_eps = int(rng.integers(1, max_episodes + 1))
     eps, crashed = [], set()
     for _ in range(n_eps):
-        e = sample_episode(rng, n_nodes, horizon, crashed=crashed)
+        e = sample_episode(rng, n_nodes, horizon, crashed=crashed,
+                           kinds=kinds)
         if e.kind == "crash":
             crashed.update(e.nodes)
         eps.append(e)
     return fltm.FaultSchedule(tuple(eps))
+
+
+def sample_edge_knobs(
+    rng: np.random.Generator,
+    n_nodes: int,
+    delay_bound: int,
+    base_drop: int = 300,
+) -> FaultConfig:
+    """One grammar draw over the per-edge FAULT MATRIX axis
+    (``--wan``): a random node->"region" clustering whose cross-
+    cluster edges carry drawn latency (+1 jitter) and drawn
+    asymmetric loss on top of ``base_drop`` — WAN-shaped mixes as
+    mutable search genes, riding the same envelope executable as
+    every scalar mix (the fleet normalizes every lane to matrix
+    knobs).  Base latencies are capped at the committed presets'
+    range (``GENE_LAT_MAX``): the protocol's retry timeouts are
+    static rounds, so a gene with EVERY edge slower than the retry
+    ladder's patience livelocks the duel — a non-convergence the
+    search would misreport as a wedge of the schedule."""
+    from tpu_paxos.config import EdgeFaultConfig
+
+    n_groups = int(rng.integers(2, max(3, n_nodes // 2 + 2)))
+    gmap = rng.integers(0, n_groups, size=n_nodes)
+    lat = rng.integers(1, 3, size=(n_groups, n_groups))
+    lat = np.minimum(lat + lat.T, GENE_LAT_MAX)  # symmetric-ish base
+    np.fill_diagonal(lat, 0)
+    loss = rng.integers(0, 1200, size=(n_nodes, n_nodes))
+    cross = gmap[:, None] != gmap[None, :]
+    mind = lat[gmap[:, None], gmap[None, :]].astype(np.int64)
+    maxd = np.minimum(mind + 1, delay_bound)
+    drop = np.where(cross, base_drop + loss, base_drop)
+    drop = np.minimum(drop, 10_000)
+    np.fill_diagonal(drop, 0)
+    # EdgeFaultConfig canonicalizes numpy rows to int tuples itself
+    return FaultConfig(
+        max_delay=int(delay_bound),
+        edges=EdgeFaultConfig(
+            drop_rate=drop,
+            dup_rate=np.zeros_like(drop),
+            min_delay=mind,
+            max_delay=maxd,
+        ),
+    )
 
 
 def _generation_margins(rep) -> dict:
@@ -179,8 +249,16 @@ def search(
     max_wedges: int = 8,
     mesh=None,
     verbose: bool = True,
+    gray: bool = False,
+    wan: bool = False,
 ) -> dict:
-    """Run the generation loop; returns the JSON-ready summary."""
+    """Run the generation loop; returns the JSON-ready summary.
+
+    ``gray=True`` adds gray-failure episodes to the grammar alphabet
+    (``KINDS_GRAY``) and ``wan=True`` mutates the per-edge fault
+    MATRIX per lane (``sample_edge_knobs``) — both opt-in: they
+    change the seeded draw sequences, and the committed fleet-quick
+    wedge artifact is pinned against the classic grammar."""
     from tpu_paxos.fleet import envelope as env
     from tpu_paxos.harness import shrink as shr
     from tpu_paxos.harness import stress as strs
@@ -192,6 +270,24 @@ def search(
     fault_kw = dict(fault_kw or dict(drop_rate=300, dup_rate=500, max_delay=2))
     wl_rng = np.random.default_rng(base_seed)
     workload, gates, chains = strs._workload(n_prop, wl_rng)
+    if wan:
+        # WAN genes need WAN timeouts: the default retry ladder is
+        # LAN-tuned (2-round timeouts), so a matrix whose edges all
+        # carry multi-round latency livelocks the duel and every lane
+        # reds on liveness — noise, not signal.  Production WAN
+        # deployments scale patience to RTT; so does the search
+        # (one protocol config for all lanes = one envelope).
+        from tpu_paxos.config import ProtocolConfig
+
+        rtt = 2 * GENE_LAT_MAX + 2
+        protocol = ProtocolConfig(
+            prepare_delay_max=rtt,
+            prepare_retry_timeout=rtt,
+            accept_retry_timeout=rtt,
+            commit_retry_timeout=rtt,
+        )
+    else:
+        protocol = None
     cfg = SimConfig(
         n_nodes=n_nodes,
         n_instances=2 * sum(len(w) for w in workload),
@@ -199,6 +295,7 @@ def search(
         seed=base_seed,
         max_rounds=20_000,
         faults=FaultConfig(**fault_kw),
+        **({"protocol": protocol} if protocol is not None else {}),
     )
     # Shared envelope cache: the search rides the same compiled
     # executable as the stress sweep's fleet mixes and the shrinker's
@@ -218,6 +315,7 @@ def search(
     )
     lane_workloads = [(workload, gates)] * n_lanes
     lane_knobs = [cfg.faults] * n_lanes
+    kinds = KINDS_GRAY if gray else KINDS
     extra = (
         {"decision_round_max": int(decision_round_max)}
         if decision_round_max else {}
@@ -230,9 +328,21 @@ def search(
     for g in range(generations):
         sched_rng = np.random.default_rng((base_seed, g))
         schedules = [
-            sample_schedule(sched_rng, n_nodes, max_episodes, horizon)
+            sample_schedule(sched_rng, n_nodes, max_episodes, horizon,
+                            kinds=kinds)
             for _ in range(n_lanes)
         ]
+        if wan:
+            # per-lane edge-matrix genes, re-drawn each generation
+            # from their own seeded stream (schedule draws untouched)
+            knob_rng = np.random.default_rng((base_seed, g, 7))
+            lane_knobs = [
+                sample_edge_knobs(
+                    knob_rng, n_nodes, runner.delay_bound,
+                    base_drop=cfg.faults.drop_rate,
+                )
+                for _ in range(n_lanes)
+            ]
         seeds = [base_seed + g * n_lanes + i for i in range(n_lanes)]
         rep = runner.run(
             seeds, schedules,
@@ -352,6 +462,12 @@ def main(argv=None) -> int:
     ap.add_argument("--decision-round-max", type=int, default=0,
                     help="flag lanes whose latest decision lands "
                     "after this round (synthetic wedge knob; 0 = off)")
+    ap.add_argument("--gray", action="store_true",
+                    help="add gray-failure episodes (per-node delay "
+                    "inflation) to the grammar alphabet")
+    ap.add_argument("--wan", action="store_true",
+                    help="mutate the per-edge fault matrix per lane "
+                    "(WAN-shaped drop/latency genes)")
     ap.add_argument("--drop-rate", type=int, default=300)
     ap.add_argument("--dup-rate", type=int, default=500)
     ap.add_argument("--max-delay", type=int, default=2)
@@ -409,6 +525,8 @@ def main(argv=None) -> int:
         max_wedges=args.max_wedges,
         mesh=mesh,
         verbose=not args.quiet,
+        gray=args.gray,
+        wan=args.wan,
     )
     print(json.dumps(summary, sort_keys=True))
     return 0 if summary["ok"] else 1

@@ -11,9 +11,14 @@ and keep every reduction that still fails —
    point);
 2. narrow each surviving episode's ``[t0, t1)`` interval by bisection
    (cut the tail half, then the head half, while the case still
-   fails);
-3. zero the i.i.d. fault knobs (drop/dup/delay/crash) one at a time;
-4. minimize the seed (try 0 and successive bisections toward 0).
+   fails), and halve surviving gray episodes' delay inflation;
+3. collapse a per-edge fault matrix (``cfg.faults.edges``) — drop it
+   entirely, else flatten it to the equivalent uniform scalar knobs —
+   so geo repros shrink to scalar configs when the matrix structure
+   is irrelevant;
+4. zero the i.i.d. fault knobs (drop/dup/delay/crash) and the
+   ``delivery_cut`` flag one at a time;
+5. minimize the seed (try 0 and successive bisections toward 0).
 
 The result is written as a JSON *repro artifact* — fully
 self-contained: config, workload, gates, in-order chains, extra
@@ -41,7 +46,12 @@ from tpu_paxos.analysis.artifact_schema import (
     ArtifactSchemaError,
     validate_artifact,
 )
-from tpu_paxos.config import FaultConfig, ProtocolConfig, SimConfig
+from tpu_paxos.config import (
+    EdgeFaultConfig,
+    FaultConfig,
+    ProtocolConfig,
+    SimConfig,
+)
 from tpu_paxos.core import faults as fltm
 from tpu_paxos.core import sim as simm
 from tpu_paxos.harness import validate
@@ -434,19 +444,67 @@ def shrink_case(
                     if narrowed is None:
                         break
                     case, changed = narrowed, True
-        # 3. zero the i.i.d. fault knobs one at a time (an acceptance
+        # 2b. halve surviving gray episodes' delay inflation toward 1
+        #     (the gray twin of interval bisection: a minimal repro
+        #     should carry the least slowness that still wedges)
+        sched = case.cfg.faults.schedule
+        if sched is not None:
+            for i in range(len(sched.episodes)):
+                while budget.left > 0:
+                    sched = case.cfg.faults.schedule
+                    ep = sched.episodes[i]
+                    if ep.kind != "gray" or ep.delay <= 1:
+                        break
+                    cand = case.with_schedule(sched.replaced(
+                        i, dataclasses.replace(ep, delay=ep.delay // 2)
+                    ))
+                    v = try_batch([cand])[0]
+                    if v is None:
+                        break
+                    note(f"gray delay -> {ep.delay // 2}")
+                    case, viol, changed = cand, v, True
+        # 3. collapse the per-edge fault matrix: drop it entirely
+        #    first (the reliable-network candidate), else flatten to
+        #    the equivalent uniform SCALAR knobs (max rates over the
+        #    matrix — keeps the fault pressure, kills the structure)
+        if case.cfg.faults.edges is not None and budget.left > 0:
+            fc = case.cfg.faults
+            e = fc.edges
+            flat = dataclasses.replace(
+                fc, edges=None,
+                drop_rate=max(max(r) for r in e.drop_rate),
+                dup_rate=max(max(r) for r in e.dup_rate),
+                min_delay=min(min(r) for r in e.min_delay),
+            )
+            cands = [
+                case.with_faults(dataclasses.replace(fc, edges=None)),
+                case.with_faults(flat),
+            ]
+            labels = ["edges dropped", "edges -> uniform scalars"]
+            vs = try_batch(cands)
+            for lbl, cand, v in zip(labels, cands, vs):
+                if v is not None:
+                    note(lbl)
+                    case, viol, changed = cand, v, True
+                    break
+        # 4. zero the i.i.d. fault knobs one at a time (an acceptance
         #    changes the base; the remaining zeroings re-batch)
         repls = [
             {"drop_rate": 0},
             {"dup_rate": 0},
             {"min_delay": 0, "max_delay": 0},
             {"crash_rate": 0},
+            {"delivery_cut": False},
         ]
         while repls and budget.left > 0:
             fc = case.cfg.faults
             live = [
                 r for r in repls
                 if not all(getattr(fc, k) == v for k, v in r.items())
+                # a surviving edge matrix pins the ring bound: zeroing
+                # max_delay under it would fail config validation (the
+                # matrix collapse above is the move that removes it)
+                and not ("max_delay" in r and fc.edges is not None)
             ]
             if not live:
                 break
@@ -508,6 +566,11 @@ def _cfg_to_dict(cfg: SimConfig) -> dict:
             "schedule": (
                 fc.schedule.to_dict() if fc.schedule is not None else None
             ),
+            # WAN fields are written only when non-default, so classic
+            # artifacts stay byte-identical to the pre-matrix format
+            **({"edges": fc.edges.to_dict()} if fc.edges is not None
+               else {}),
+            **({"delivery_cut": True} if fc.delivery_cut else {}),
         },
     }
 
@@ -515,6 +578,7 @@ def _cfg_to_dict(cfg: SimConfig) -> dict:
 def _cfg_from_dict(d: dict) -> SimConfig:
     f = dict(d["faults"])
     sched = f.pop("schedule", None)
+    edges = f.pop("edges", None)
     return SimConfig(
         n_nodes=d["n_nodes"],
         n_instances=d["n_instances"],
@@ -527,6 +591,9 @@ def _cfg_from_dict(d: dict) -> SimConfig:
             **f,
             schedule=(
                 fltm.FaultSchedule.from_dict(sched) if sched else None
+            ),
+            edges=(
+                EdgeFaultConfig.from_dict(edges) if edges else None
             ),
         ),
     )

@@ -140,6 +140,58 @@ MIXES = [
 # smoke) — derived structurally so reordering MIXES cannot drift it.
 EPISODE_MIXES = [m for m in MIXES if "schedule" in m[1]]
 
+# WAN geo mixes (core/wan.py): per-edge [A, A] latency/loss matrices
+# from the topology presets, a gray episode (the slow-region outage no
+# crash or pause can express), and an asymmetric long-haul cut.  Same
+# 5-node/2-proposer geometry and the envelope's default ring bound as
+# the episode mixes above, so sweep_fleet runs them on the SAME
+# compiled executable (zero warm compiles across mixes — the
+# BENCH_geo.json claim).  Fleet-only: the sharded sweep keeps the
+# classic episode mixes.
+from tpu_paxos.core import wan as wanm  # noqa: E402  (pure numpy)
+
+SCHED_WAN_GRAY = flt.FaultSchedule((
+    # the lone 'ap' node (round-robin region map of 5 nodes over 3
+    # regions puts node 2 alone in ap) goes gray mid-run, then the
+    # transpacific link drops one direction
+    flt.gray(8, 40, 2, delay=3),
+    flt.one_way(20, 48, (2,), (0, 1)),
+))
+SCHED_WAN5_GRAY = flt.FaultSchedule((
+    # a whole region slows (nodes 3 = ap, 4 = sa on the 5-region
+    # round-robin), composing with a short partition of the tail
+    flt.gray(6, 36, 3, 4, delay=2),
+    flt.partition(24, 44, (0, 1, 2), (3, 4)),
+))
+WAN_MIXES = [
+    (
+        "wan-3region",
+        dict(
+            max_delay=wanm.PRESET_DELAY_BOUND,
+            edges=wanm.edge_faults(wanm.WAN3, 5),
+            schedule=SCHED_WAN_GRAY,
+        ),
+        5,
+        2,
+    ),
+    (
+        "wan-5region",
+        dict(
+            max_delay=wanm.PRESET_DELAY_BOUND,
+            edges=wanm.edge_faults(wanm.WAN5, 5),
+            schedule=SCHED_WAN5_GRAY,
+        ),
+        5,
+        2,
+    ),
+]
+#: node->region maps per WAN mix label (the recorder's region-pair
+#: counters; sweep_fleet threads them through run(regions=))
+WAN_REGIONS = {
+    "wan-3region": wanm.node_regions(wanm.WAN3, 5),
+    "wan-5region": wanm.node_regions(wanm.WAN5, 5),
+}
+
 N_IDS = 6  # ids per client chain (gated, in-order)
 N_FREE = 8  # ungated values per proposer
 
@@ -314,6 +366,9 @@ def _mix_telemetry(rep, cfg: SimConfig) -> dict:
             "decided", "takeovers", "requeues", "restarts",
             "heal_gap_min", "stall_depth_max", "duel_depth_max",
         )},
+        # the WAN plane: offered-vs-dropped per region pair (all-zero
+        # maps collapse to one 1x1 "region" for the classic mixes)
+        "region_pairs": agg["region_pairs"],
         **({"windows": agg["windows"]} if "windows" in agg else {}),
         "drop_rate_configured": cfg.faults.drop_rate,
         "drop_rate_observed": (
@@ -360,7 +415,7 @@ def sweep_fleet(
     logger = logm.get_logger(
         "stress", logm.parse_level("INFO" if verbose else "WARN")
     )
-    mixes = EPISODE_MIXES if mixes is None else mixes
+    mixes = (EPISODE_MIXES + WAN_MIXES) if mixes is None else mixes
     runs, failures = 0, []
     lane_seconds, lanes_total = 0.0, 0
     compiles_per_mix: dict[str, int] = {}
@@ -394,11 +449,13 @@ def sweep_fleet(
                 cfg, lanes[0][1], lanes[0][2], telemetry=True
             )
             before = census.engine_counts.get("fleet", 0)
+            rmap = WAN_REGIONS.get(label)
             rep = runner.run(
                 [ln[0] for ln in lanes],
                 [sched] * n_seeds,
                 workloads=[(ln[1], ln[2]) for ln in lanes],
                 knobs=[cfg.faults] * n_seeds,
+                regions=None if rmap is None else [rmap] * n_seeds,
             )
             compiles_per_mix[label] = (
                 census.engine_counts.get("fleet", 0) - before

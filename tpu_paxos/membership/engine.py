@@ -289,7 +289,7 @@ def _build_round(
         t = st.t
         exist = ~st.crashed  # [N] not-crashed (excusals key off this)
         if runtime_schedule:
-            reach_t, pause_t, _extra = stm.masks_at(tab, t)
+            reach_t, pause_t, _extra, _gray = stm.masks_at(tab, t)
             reach2_t = reach_t & reach_t.T  # synchronous exchange
             sched_crash = stm.crashes_at(tab, t)
             alive = exist & ~pause_t
@@ -927,7 +927,12 @@ def _check_member_schedule(schedule) -> None:
     the compiled-constant and runtime-table paths) — but never of
     node 0, which plays the reference harness's driver role
     (member/main.cpp proposes and churns through nodes[0]; the
-    host ``crash()`` injector enforces the same rule)."""
+    host ``crash()`` injector enforces the same rule).  ``gray``
+    episodes are REJECTED by name: member/'s network is synchronous
+    (request and reply in one step — there is no arrival calendar to
+    inflate), so gray delay inflation has no lowering here; the
+    WAN-shaped gray model belongs to the calendar network of
+    core/sim."""
     if schedule is None:
         return
     for e in schedule.episodes:
@@ -935,6 +940,12 @@ def _check_member_schedule(schedule) -> None:
             raise ValueError(
                 "node 0 is the harness driver; it stays up (crash "
                 f"episode at t0={e.t0} names node 0)"
+            )
+        if e.kind == "gray":
+            raise ValueError(
+                "the membership engine does not support gray episodes "
+                "(synchronous network — no arrival calendar to "
+                f"inflate; gray episode at [{e.t0},{e.t1}))"
             )
 
 

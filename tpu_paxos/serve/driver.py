@@ -108,7 +108,9 @@ def init_serve_state(
     Returns ``(state, queue_cap)``."""
     pend, gate, tail, c = empty_queues(cfg, workload)
     st = simm.init_state(cfg, pend, gate, tail, root)
-    tele = telem.init_telemetry(cfg.n_instances, len(cfg.proposers))
+    tele = telem.init_telemetry(
+        cfg.n_instances, len(cfg.proposers), cfg.n_nodes
+    )
     if window_rounds:
         tele = (tele, telem.init_windows())
     ingest = jnp.full((int(vid_bound),), val.NONE, jnp.int32)

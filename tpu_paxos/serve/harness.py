@@ -85,47 +85,94 @@ class ServeSLO:
     fast), and a window at or above ``burn_breach`` is a named breach
     window — which is exactly what the run-total histogram cannot
     see: a mid-run breach that later traffic dilutes below the budget
-    leaves the final histogram green."""
+    leaves the final histogram green.
+
+    ``regions`` declares PER-REGION latency budgets for a WAN-shaped
+    deployment: ``((name, latency_rounds), ...)`` pairs keyed off a
+    topology preset's region names (``core/wan.py`` — use
+    :func:`region_slo` to build one from a preset).  Each region's
+    threshold is judged as its own SLO with breach windows NAMED per
+    region in the verdict's ``regions`` block — but, today, every
+    region judges the GLOBAL windowed latency series: the recorder
+    carries one cluster-wide histogram, so a region's verdict means
+    "the cluster met this region's declared budget", not "this
+    region's own decisions did".  Per-region latency SERIES (so a
+    slow far region cannot red-flag a fast near one) arrive with
+    item 2's per-lane serve fleet — this field is that hook's
+    declaration surface, shipped now so WAN presets, dashboards, and
+    sweeps carry named region budgets end to end.  The global
+    ``latency_rounds`` stays the cluster-wide floor judgment; the
+    report's ``ok`` requires the global AND every region to hold."""
 
     latency_rounds: int
     budget_milli: int = 100
     burn_breach: float = 1.0
+    regions: tuple = ()
+
+    def __post_init__(self) -> None:
+        object.__setattr__(
+            self, "regions",
+            tuple((str(n), int(r)) for n, r in self.regions),
+        )
 
 
-def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
-    """Judge one run's windowed latency series against ``slo``:
-    per-window totals/bad-counts/burn rates, the named breach
-    windows (with their round spans), and the run-total verdict the
-    windowed one is compared against.  ``windows_dict`` is the
-    recorder's ``windows_to_dict`` output (the ``"windows"`` block of
-    a summary dict) — this function is pure host arithmetic, so the
-    monitor can run per dispatch at no device cost."""
+def region_slo(
+    preset,
+    budgets: dict,
+    *,
+    latency_rounds: int,
+    budget_milli: int = 100,
+    burn_breach: float = 1.0,
+) -> ServeSLO:
+    """A :class:`ServeSLO` with per-region latency budgets keyed off
+    a WAN preset's region names (``core/wan.WanPreset``): ``budgets``
+    maps region name -> latency_rounds; every name must belong to the
+    preset (a typo'd region would otherwise silently go unjudged)."""
+    unknown = sorted(set(budgets) - set(preset.regions))
+    if unknown:
+        raise ValueError(
+            f"unknown region(s) {', '.join(unknown)} for preset "
+            f"{preset.name!r} (has: {', '.join(preset.regions)})"
+        )
+    return ServeSLO(
+        latency_rounds=latency_rounds,
+        budget_milli=budget_milli,
+        burn_breach=burn_breach,
+        regions=tuple(sorted(budgets.items())),
+    )
+
+
+def _judge_series(
+    hist, wr: int, latency_rounds: int, budget_milli: int,
+    burn_breach: float,
+) -> dict:
+    """One latency threshold judged over a ``[W, B]`` windowed
+    histogram: per-window totals/bad-counts/burn rates, named breach
+    windows with round spans, and the run-total verdict."""
     import bisect
 
     from tpu_paxos.telemetry import recorder as telem
 
-    hist = np.asarray(windows_dict["lat_hist"], np.int64)  # [W, B]
-    wr = int(windows_dict["window_rounds"])
-    k = bisect.bisect_right(telem.LAT_EDGES, int(slo.latency_rounds))
+    k = bisect.bisect_right(telem.LAT_EDGES, int(latency_rounds))
     eff = telem.LAT_EDGES[k - 1] if k else 0
     tot = hist.sum(axis=1)
     bad = hist[:, k:].sum(axis=1)
-    budget = max(int(slo.budget_milli), 1) / 1000.0
+    budget = max(int(budget_milli), 1) / 1000.0
     burn = [
         round(float(b) / float(t) / budget, 3) if t else 0.0
         for b, t in zip(bad, tot)
     ]
     breach = [
         w for w, bn in enumerate(burn)
-        if tot[w] and bn >= slo.burn_breach
+        if tot[w] and bn >= burn_breach
     ]
     t_tot, b_tot = int(tot.sum()), int(bad.sum())
     frac_milli = round(1000.0 * b_tot / t_tot, 1) if t_tot else 0.0
     return {
-        "latency_rounds": int(slo.latency_rounds),
+        "latency_rounds": int(latency_rounds),
         "latency_rounds_effective": int(eff),
-        "budget_milli": int(slo.budget_milli),
-        "burn_breach": float(slo.burn_breach),
+        "budget_milli": int(budget_milli),
+        "burn_breach": float(burn_breach),
         "window_rounds": wr,
         "decided": tot.tolist(),
         "bad": bad.tolist(),
@@ -144,8 +191,49 @@ def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
         # the run-total judgment the windowed one exists to correct:
         # a mid-run breach can hide under a green total
         "total_bad_milli": frac_milli,
-        "total_ok": frac_milli <= float(slo.budget_milli),
+        "total_ok": frac_milli <= float(budget_milli),
     }
+
+
+def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
+    """Judge one run's windowed latency series against ``slo``:
+    per-window totals/bad-counts/burn rates, the named breach
+    windows (with their round spans), and the run-total verdict the
+    windowed one is compared against.  ``windows_dict`` is the
+    recorder's ``windows_to_dict`` output (the ``"windows"`` block of
+    a summary dict) — this function is pure host arithmetic, so the
+    monitor can run per dispatch at no device cost.
+
+    With per-region budgets declared (``slo.regions``), each region's
+    latency threshold is judged as its own SLO and named in the
+    ``regions`` block (``regions_ok`` aggregates them); the top-level
+    ``ok`` then requires the global verdict AND every region's."""
+    hist = np.asarray(windows_dict["lat_hist"], np.int64)  # [W, B]
+    wr = int(windows_dict["window_rounds"])
+    out = _judge_series(
+        hist, wr, slo.latency_rounds, slo.budget_milli, slo.burn_breach
+    )
+    if slo.regions:
+        region_verdicts = {
+            name: _judge_series(
+                hist, wr, lat, slo.budget_milli, slo.burn_breach
+            )
+            for name, lat in slo.regions
+        }
+        regions_ok = all(v["ok"] for v in region_verdicts.values())
+        out["regions"] = {
+            name: {
+                k: v[k] for k in (
+                    "latency_rounds", "latency_rounds_effective",
+                    "burn", "burn_max", "breach_windows",
+                    "breach_spans", "ok", "total_bad_milli", "total_ok",
+                )
+            }
+            for name, v in region_verdicts.items()
+        }
+        out["regions_ok"] = regions_ok
+        out["ok"] = bool(out["ok"] and regions_ok)
+    return out
 
 
 @dataclasses.dataclass

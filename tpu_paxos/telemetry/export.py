@@ -115,6 +115,19 @@ def _episode_events(schedule, n_nodes: int, net_pid: int) -> list:
                 ts=ts, dur=dur,
                 args={"t0": e.t0, "t1": e.t1, "drop_rate": e.drop_rate},
             ))
+        elif e.kind == "gray":
+            for node in e.nodes:
+                events.append(_ev(
+                    "X", f"gray +{e.delay} rounds", int(node), ts=ts,
+                    dur=dur,
+                    args={"t0": e.t0, "t1": e.t1, "delay": e.delay},
+                ))
+        elif e.kind == "crash":
+            for node in e.nodes:
+                events.append(_ev(
+                    "i", "crash point", int(node), ts=ts, s="p",
+                    args={"t0": e.t0},
+                ))
     return events
 
 
@@ -150,6 +163,34 @@ def _window_counter_events(windows: dict, tele_pid: int) -> list:
     return events
 
 
+def _region_counter_events(
+    region_pairs: dict, tele_pid: int, t_end_us: int
+) -> list:
+    """The per-REGION-pair fault breakdown as counter tracks: one
+    ``drop rate r<s>-><d>`` counter per pair with traffic (run-total
+    observed rate, rendered flat across the run so a gray/lossy WAN
+    link stands out next to the time-resolved tracks).  Rendered only
+    for multi-region runs — the 1x1 unassigned collapse says
+    nothing the global drop-rate track doesn't."""
+    events = []
+    n = int(region_pairs.get("n_regions", 1))
+    if n <= 1:
+        return events
+    rates = region_pairs["drop_rate_observed"]
+    offered = region_pairs["offered"]
+    for s in range(n):
+        for d in range(n):
+            if not offered[s][d]:
+                continue
+            name = f"region drop r{s}->r{d} (/1e4)"
+            for ts in (0, t_end_us):
+                events.append(_ev(
+                    "C", name, tele_pid, ts=ts,
+                    args={name: rates[s][d]},
+                ))
+    return events
+
+
 def chrome_trace(
     cfg, result, summary_dict=None, label="tpu-paxos",
     max_decision_events: int = MAX_DECISION_EVENTS,
@@ -178,6 +219,11 @@ def chrome_trace(
     if windows is not None:
         _meta(events, tele_pid, _TELEMETRY_TRACK)
         events += _window_counter_events(windows, tele_pid)
+    region_pairs = (summary_dict or {}).get("region_pairs")
+    if region_pairs is not None and windows is not None:
+        events += _region_counter_events(
+            region_pairs, tele_pid, int(result.rounds) * ROUND_US
+        )
     events += _episode_events(cfg.faults.schedule, a, net_pid)
 
     # decisions: instants on the decision track + a cumulative counter

@@ -85,6 +85,15 @@ NUM_LAT_BUCKETS = len(LAT_EDGES) + 1
 NUM_WINDOWS = 16
 WINDOW_ROUNDS = 16
 
+#: Fixed region capacity of the per-REGION-pair fault counters: the
+#: node->region assignment is a RUNTIME ``[A]`` int32 map (clamped
+#: into this bound), so one compiled program serves every WAN
+#: topology preset — 3-region and 5-region runs share the same
+#: ``[R, R]`` summary shape and the same executable.  Unassigned runs
+#: default to the all-zero map: every edge lands in region pair
+#: (0, 0).
+NUM_REGIONS = 8
+
 
 class Telemetry(NamedTuple):
     """Per-round accumulators carried through the traced loop (one
@@ -107,6 +116,12 @@ class Telemetry(NamedTuple):
     admit_round: np.ndarray  # [I] int32 first round in an accept batch
     takeover_round: np.ndarray  # [P] int32 first takeover round (NONE)
     stall_max: np.ndarray  # int32 max stall counter ever observed
+    edge_offered: np.ndarray  # [A, A] int32 per-edge offered copies
+    #     (all message types; post-cut like ``offered``) — the
+    #     WAN-shaped breakdown summarize() reduces to per-REGION-pair
+    #     totals, so a gray/lossy link is visible without an [A, A]
+    #     series crossing per round
+    edge_dropped: np.ndarray  # [A, A] int32 per-edge dropped copies
 
 
 class TelemetryWindows(NamedTuple):
@@ -169,9 +184,13 @@ class TelemetrySummary(NamedTuple):
     takeover_round: np.ndarray  # [P] int32 first takeover round (NONE)
     rounds: np.ndarray  # int32 rounds simulated
     quiescent: np.ndarray  # bool the engine's done predicate held
+    region_offered: np.ndarray  # [R, R] int32 offered per region pair
+    region_dropped: np.ndarray  # [R, R] int32 dropped per region pair
 
 
-def init_telemetry(n_instances: int, n_proposers: int) -> Telemetry:
+def init_telemetry(
+    n_instances: int, n_proposers: int, n_nodes: int
+) -> Telemetry:
     """Zeroed accumulators for one lane (host numpy: the fleet runner
     feeds these through ``jnp.asarray`` like every other lane input)."""
     import jax.numpy as jnp
@@ -189,6 +208,8 @@ def init_telemetry(n_instances: int, n_proposers: int) -> Telemetry:
         admit_round=jnp.full((n_instances,), val.NONE, jnp.int32),
         takeover_round=jnp.full((n_proposers,), val.NONE, jnp.int32),
         stall_max=jnp.int32(0),
+        edge_offered=jnp.zeros((n_nodes, n_nodes), jnp.int32),
+        edge_dropped=jnp.zeros((n_nodes, n_nodes), jnp.int32),
     )
 
 
@@ -299,11 +320,32 @@ def serve_admit_rounds(ingest, chosen_vid):
     return jnp.where(ok, adm, val.NONE)
 
 
-def summarize(tele: Telemetry, final, horizon) -> TelemetrySummary:
+def region_reduce(edge_counts, region_map):
+    """Reduce one ``[A, A]`` per-edge counter to fixed-shape
+    ``[NUM_REGIONS, NUM_REGIONS]`` per-region-pair totals via the
+    runtime node->region map (``[A]`` int32, clamped into the region
+    bound so a malformed map can never scatter out of shape).  On
+    device, inside the summary epilogue."""
+    import jax.numpy as jnp
+
+    r = jnp.clip(
+        jnp.asarray(region_map, jnp.int32), 0, NUM_REGIONS - 1
+    )  # [A]
+    return jnp.zeros((NUM_REGIONS, NUM_REGIONS), jnp.int32).at[
+        r[:, None], r[None, :]
+    ].add(edge_counts)
+
+
+def summarize(
+    tele: Telemetry, final, horizon, region_map=None
+) -> TelemetrySummary:
     """Reduce one lane's accumulators + final state to the fixed-shape
     summary, on device.  ``final`` is the engine's final ``SimState``;
     ``horizon`` is the schedule's last-heal round (int, or a traced
-    scalar from a runtime ``ScheduleTable``)."""
+    scalar from a runtime ``ScheduleTable``); ``region_map`` is the
+    ``[A]`` int32 node->region assignment for the per-region-pair
+    fault counters (None = every node in region 0 — the same traced
+    program, a constant zero map)."""
     import jax.numpy as jnp
 
     met = final.met
@@ -324,6 +366,10 @@ def summarize(tele: Telemetry, final, horizon) -> TelemetrySummary:
     heal_gap = jnp.where(
         final.done, final.t - jnp.asarray(horizon, jnp.int32), jnp.int32(-1)
     )
+    if region_map is None:
+        region_map = jnp.zeros(
+            (tele.edge_offered.shape[0],), jnp.int32
+        )
     return TelemetrySummary(
         msgs=met.msgs,
         offered=tele.offered,
@@ -344,10 +390,37 @@ def summarize(tele: Telemetry, final, horizon) -> TelemetrySummary:
         takeover_round=tele.takeover_round,
         rounds=final.t,
         quiescent=final.done,
+        region_offered=region_reduce(tele.edge_offered, region_map),
+        region_dropped=region_reduce(tele.edge_dropped, region_map),
     )
 
 
 # ---------------- host-side rendering ----------------
+
+
+def region_pairs_dict(region_offered, region_dropped) -> dict:
+    """The per-region-pair offered/dropped block, TRIMMED to the used
+    region prefix (the [R, R] device shape is a fixed envelope; a
+    3-region run renders 3x3).  Always at least 1x1 — region 0 holds
+    everything for unassigned runs."""
+    off = np.asarray(region_offered)
+    drp = np.asarray(region_dropped)
+    used = np.flatnonzero(
+        off.any(axis=0) | off.any(axis=1) | drp.any(axis=0) | drp.any(axis=1)
+    )
+    r = int(used.max()) + 1 if used.size else 1
+    return {
+        "n_regions": r,
+        "offered": off[:r, :r].tolist(),
+        "dropped": drp[:r, :r].tolist(),
+        "drop_rate_observed": [
+            [
+                round(1e4 * float(d) / float(o), 1) if int(o) else 0.0
+                for d, o in zip(drow, orow)
+            ]
+            for drow, orow in zip(drp[:r, :r], off[:r, :r])
+        ],
+    }
 
 
 def latency_quantile(hist: np.ndarray, q: float, lat_max: int) -> int:
@@ -449,6 +522,7 @@ def summary_to_dict(
         "takeover_round": np.asarray(s.takeover_round).tolist(),
         "rounds": int(s.rounds),
         "quiescent": bool(s.quiescent),
+        "region_pairs": region_pairs_dict(s.region_offered, s.region_dropped),
         **(
             {"windows": windows_to_dict(windows, window_rounds, lat_max)}
             if windows is not None else {}
@@ -528,6 +602,10 @@ def reduce_lanes(
     )
     return {
         **win_blk,
+        "region_pairs": region_pairs_dict(
+            np.asarray(s.region_offered).sum(axis=0),
+            np.asarray(s.region_dropped).sum(axis=0),
+        ),
         "offered": int(np.asarray(s.offered).sum()),
         "dropped": int(np.asarray(s.dropped).sum()),
         "duped": int(np.asarray(s.duped).sum()),
