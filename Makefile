@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-slow check lint lint-json audit audit-json bench \
 	bench-sharded parity parity-fast replay-diff replay-diff-member \
 	run stress stress-quick fleet fleet-quick mc mc-quick serve \
-	serve-quick clean
+	serve-quick serve-fleet serve-fleet-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -57,7 +57,7 @@ audit-json:
 # un-jitted op-by-op smoke of one tiny config per engine (every cond
 # predicate, slice bound, and dtype materializes eagerly).  The pallas
 # interpreter path is part of the fast tier (tests/test_fastwin.py).
-check: lint audit mc-quick serve-quick
+check: lint audit mc-quick serve-quick serve-fleet-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
@@ -154,6 +154,26 @@ serve-quick:
 	$(PY) -m tpu_paxos serve --values 64 --rate-milli 4000 \
 	  --drop-rate 500 --dup-rate 1000 --max-delay 2
 	$(PY) -m tpu_paxos serve --values 64 --rate-milli 0
+
+# Fleet serving (tpu_paxos/serve/fleet.py): many tenant streams per
+# dispatch — the serve window vmapped over [lanes] with on-device
+# per-lane SLO verdicts; prints the (lanes x rates) aggregate
+# sustained-values/sec + knee SURFACE.  SERVE_LANES=l,l,... /
+# VALUES=n override (a ?= variable like SERVE_RATES: commas inside
+# $(or ...) would split into separate arguments).
+SERVE_LANES ?= 1,2,4,8
+serve-fleet:
+	$(PY) -m tpu_paxos serve --fleet --lane-counts $(SERVE_LANES) \
+	  --values $(or $(VALUES),128) --sweep $(SERVE_RATES) \
+	  --drop-rate 500 --dup-rate 1000 --max-delay 2 $(SERVE_FLAGS)
+
+# Quick pass (wired into make check): a small 2-lane fleet at a
+# sustained rate with an SLO armed; exits non-zero if any lane fails
+# to drain or the confirmed SLO verdict breaches.
+serve-fleet-quick:
+	$(PY) -m tpu_paxos serve --fleet --lanes 2 --values 48 \
+	  --rate-milli 4000 --slo-latency 128 \
+	  --drop-rate 500 --dup-rate 1000 --max-delay 2
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

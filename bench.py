@@ -1068,6 +1068,264 @@ def bench_serve_record() -> dict:
     return record
 
 
+def _serve_fleet_record(
+    cells, knee_surface, warm_compiles, parity_failures, config
+) -> dict:
+    """Record-or-error for the fleet-serving (lanes x offered-rates)
+    surface — pure, so tests/test_bench_guards.py drives it with
+    synthetic cells (the ``_geo_record`` discipline).  Three withhold
+    conditions, each fatal to the WHOLE record:
+
+    - ``parity_failures``: the 1-lane zero-load fleet run must be
+      decision-log sha256-identical to closed-loop ``run()`` (which
+      chains through the pinned serve==closed-loop parity) — a
+      mismatch means the lane program forked the protocol and every
+      latency number is about a different system;
+    - ``warm_compiles``: the surface's claim IS the shared envelope
+      executable — any XLA compile during the measured grid (after
+      the per-lane-count warm pass) withholds the record;
+    - roofline: each cell's ``lanes x state_bytes x rounds`` bounds
+      the traffic its timing implies; an implausible cell withholds
+      the record naming the (lanes, rate) cell.
+
+    ``cells`` carry {lanes, rate_milli, wall_s, rounds, decided,
+    state_bytes, sustained}; the published value is the aggregate
+    sustained-values/sec SURFACE keyed [lanes][rate_milli], with the
+    per-lane-count knee brackets alongside (a knee SURFACE, not a
+    knee point)."""
+    raw = [
+        {k: (round(c[k], 4) if k == "wall_s" else c[k])
+         for k in ("lanes", "rate_milli", "wall_s", "rounds",
+                   "decided", "sustained")}
+        for c in cells
+    ]
+    if parity_failures:
+        return {
+            "engine": "serve_fleet",
+            "error": (
+                "zero-load parity withheld the record: "
+                + "; ".join(str(p) for p in parity_failures)
+            ),
+            "cells": raw,
+            "config": config,
+        }
+    if warm_compiles:
+        return {
+            "engine": "serve_fleet",
+            "error": (
+                f"one-envelope-executable claim failed: {warm_compiles} "
+                "warm XLA compiles during the measured (lanes x rates) "
+                "grid — the surface is not one executable per "
+                "lane-count shape, record withheld"
+            ),
+            "cells": raw,
+            "config": config,
+        }
+    devices = config.get("devices", 1)
+    for c in cells:
+        refusal = _implausible(
+            int(c["lanes"]) * int(c["state_bytes"]) * max(int(c["rounds"]), 1),
+            float(c["wall_s"]), devices,
+        )
+        if refusal is not None:
+            return {
+                "engine": "serve_fleet",
+                "error": (
+                    f"cell (lanes={c['lanes']}, "
+                    f"rate_milli={c['rate_milli']}): {refusal}"
+                ),
+                "cells": raw,
+                "config": config,
+            }
+    surface: dict = {}
+    for c in cells:
+        surface.setdefault(str(c["lanes"]), {})[str(c["rate_milli"])] = (
+            round(c["decided"] / max(float(c["wall_s"]), 1e-9), 1)
+        )
+    return {
+        "engine": "serve_fleet",
+        "metric": "serve_fleet_sustained_values_per_sec_surface",
+        "value": surface,
+        "unit": "values/sec (aggregate across lanes)",
+        "knee_surface": knee_surface,
+        "warm_compiles_across_grid": int(warm_compiles),
+        "cells": raw,
+        "config": config,
+    }
+
+
+# jax.monitoring has no listener-removal API, so the fleet-serving
+# bench reuses one module-level census (the stress sweep's pattern)
+# instead of leaking a deactivated listener per call.
+_serve_fleet_census = None
+
+
+def bench_serve_fleet_record() -> dict:
+    """Secondary record: FLEET SERVING (tpu_paxos/serve/fleet.py) —
+    the headline (lanes x offered-rates) SURFACE: aggregate sustained
+    values/sec per cell and the saturation knee per lane count, every
+    cell of a lane count riding the envelope cache's one executable
+    (zero warm compiles across the measured grid, pinned by the
+    record guard), parity-anchored by a 1-lane zero-load fleet run
+    that must be decision-log-identical to closed-loop ``run()``."""
+    import hashlib
+
+    import numpy as np
+
+    from tpu_paxos.analysis import tracecount
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.core import sim as simm
+    from tpu_paxos.replay.decision_log import decision_log
+    from tpu_paxos.serve import arrivals as arrv
+    from tpu_paxos.serve import driver as sdrv
+    from tpu_paxos.serve import fleet as sflt
+    from tpu_paxos.serve import harness as sharness
+    from tpu_paxos.utils import prng
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # values per lane: long enough that an overload rate builds REAL
+    # queueing inside the windowed series (the knee must be able to
+    # cross — a too-short stream drains before its median doubles)
+    n_values = int(
+        os.environ.get("TPU_PAXOS_BENCH_SERVE_FLEET_VALUES",
+                       1 << 12 if on_tpu else 1 << 10)
+    )
+    lane_counts = [1, 2, 4, 8] if not on_tpu else [1, 8, 64, 256]
+    rates = [2000, 8000, 32_000, 128_000]
+    r_window, s_dispatch, w_rounds = 2, 32, 128
+    seed = 0
+    cfg = SimConfig(
+        n_nodes=5,
+        n_instances=2 * n_values,
+        proposers=(0, 1),
+        seed=seed,
+        max_rounds=20_000,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+    slo = sharness.ServeSLO(latency_rounds=64, budget_milli=100)
+
+    # ---- zero-load parity anchor: 1-lane fleet == closed-loop run()
+    vids = np.arange(n_values, dtype=np.int32)
+    zl_streams, _ = arrv.split_round_robin(
+        vids, arrv.immediate_rounds(n_values), 2
+    )
+    zl_arrs = [np.zeros(len(s), np.int32) for s in zl_streams]
+
+    def _sha(cv, cb):
+        return hashlib.sha256(
+            decision_log(cv, cb, stride=64, n_instances=len(cv)).encode()
+        ).hexdigest()
+
+    parity_failures = []
+    zrep = sflt.serve_fleet_run(
+        cfg, [sflt.ServeLane(zl_streams, zl_arrs, seed)],
+        rounds_per_window=r_window,
+        windows_per_dispatch=s_dispatch,
+    )
+    closed = simm.run(cfg, zl_streams)
+    cv, cb = zrep.lane_chosen(0)
+    if _sha(cv, cb) != _sha(closed.chosen_vid, closed.chosen_ballot):
+        parity_failures.append(
+            "1-lane zero-load fleet serve != closed-loop run() "
+            "(decision-log sha256)"
+        )
+
+    # ---- ONE admit width across the whole measured grid (the call
+    # shape per lane count; the grid must not fork executables per
+    # rate), then a warm pass per lane-count shape, then the measured
+    # grid under the census — 0 compiles expected.  sweep_fleet_load
+    # treats the passed width as authoritative, so the grid's plans
+    # are built once here and once per measured cell, never twice.
+    width = sflt.grid_admit_width(
+        cfg, n_values, lane_counts, rates, seed=seed,
+        rounds_per_window=r_window,
+    )
+    for lc in lane_counts:
+        sflt.serve_fleet_run(
+            cfg, sflt.fleet_lanes(cfg, lc, n_values, rates[0], seed),
+            rounds_per_window=r_window,
+            windows_per_dispatch=s_dispatch,
+            admit_width=width,
+            window_rounds=w_rounds,
+            slo=slo,
+        )
+    global _serve_fleet_census
+    if _serve_fleet_census is None:
+        _serve_fleet_census = tracecount.CompileCensus()
+    census = _serve_fleet_census.start()
+    before = census.engine_counts.get("serve_fleet", 0)
+    try:
+        sweep = sflt.sweep_fleet_load(
+            cfg, n_values, lane_counts, rates,
+            seed=seed,
+            rounds_per_window=r_window,
+            windows_per_dispatch=s_dispatch,
+            admit_width=width,
+            window_rounds=w_rounds,
+            slo=slo,
+        )
+    finally:
+        warm_compiles = census.engine_counts.get("serve_fleet", 0) - before
+        census.stop()
+
+    grid_streams = sflt.fleet_lanes(cfg, 1, n_values, rates[0], seed)[0]
+    state_bytes = _state_nbytes(
+        sdrv.init_serve_state(
+            cfg, grid_streams.workload, n_values, prng.root_key(seed),
+            window_rounds=w_rounds,
+        )[0]
+    )
+    cells = []
+    for lc in lane_counts:
+        for pt in sweep["cells"][str(lc)]["points"]:
+            cells.append({
+                "lanes": lc,
+                "rate_milli": pt["rate_milli"],
+                "wall_s": pt["wall_seconds"],
+                "rounds": pt["rounds"],
+                "decided": pt["decided"],
+                "state_bytes": state_bytes,
+                "sustained": pt["sustained"],
+            })
+    config = {
+        "n_nodes": cfg.n_nodes,
+        "n_instances": cfg.n_instances,
+        "n_values_per_lane": n_values,
+        "lane_counts": lane_counts,
+        "rates_milli": rates,
+        "rounds_per_window": r_window,
+        "windows_per_dispatch": s_dispatch,
+        "admit_width": width,
+        "window_rounds": w_rounds,
+        "faults": "drop500/dup1000/delay0-2",
+        "arrivals": "poisson (per-lane seed-mixed streams)",
+        "slo": {"latency_rounds": 64, "budget_milli": 100},
+        "latency_unit": "rounds (virtual clock)",
+        "devices": 1,
+        "platform": jax.devices()[0].platform,
+    }
+    record = _serve_fleet_record(
+        cells, sweep["knee_surface"], warm_compiles, parity_failures,
+        config,
+    )
+    if "error" not in record:
+        # the per-lane-count latency columns the knee read (steady
+        # medians + breach lanes), small and JSON-ready
+        record["latency_at_load"] = {
+            str(lc): [
+                {k: pt[k] for k in (
+                    "rate_milli", "p50", "p99", "decided", "backlog",
+                    "sustained", "breach_lanes",
+                ) if k in pt}
+                | ({"p50_steady": pt["p50_steady"]}
+                   if "p50_steady" in pt else {})
+                for pt in sweep["cells"][str(lc)]["points"]
+            ]
+            for lc in lane_counts
+        }
+    return record
+
+
 def _member_record(host_runs, dev_runs, state_bytes, config) -> dict:
     """Record-or-error for the membership host-vs-device timing pairs
     — pure, so tests/test_bench_guards.py drives it with synthetic
@@ -1536,6 +1794,13 @@ def main() -> None:
                 secondary.append(bench_serve_record())
             except Exception as e:
                 secondary.append({"engine": "serve", "error": str(e)[:500]})
+        if os.environ.get("TPU_PAXOS_BENCH_SERVE_FLEET", "1") == "1":
+            try:
+                secondary.append(bench_serve_fleet_record())
+            except Exception as e:
+                secondary.append(
+                    {"engine": "serve_fleet", "error": str(e)[:500]}
+                )
         if os.environ.get("TPU_PAXOS_BENCH_MEMBER", "1") == "1":
             try:
                 secondary.append(bench_member_record())

@@ -217,6 +217,70 @@ def test_serve_record_withholds_on_p99_mismatch():
     assert "value" not in rec
 
 
+def _fleet_cells():
+    return [
+        {"lanes": 1, "rate_milli": 4000, "wall_s": 0.50, "rounds": 200,
+         "decided": 256, "state_bytes": 1 << 20, "sustained": True},
+        {"lanes": 8, "rate_milli": 4000, "wall_s": 0.60, "rounds": 200,
+         "decided": 2048, "state_bytes": 1 << 20, "sustained": True},
+    ]
+
+
+def test_serve_fleet_record_publishes_surface():
+    knee = [{"lanes": 1, "last_sustained_milli": 4000,
+             "first_saturated_milli": None}]
+    rec = bench._serve_fleet_record(
+        _fleet_cells(), knee, 0, [], {"devices": 1}
+    )
+    assert rec["metric"] == "serve_fleet_sustained_values_per_sec_surface"
+    assert rec["value"]["1"]["4000"] == pytest.approx(256 / 0.50, abs=0.1)
+    assert rec["value"]["8"]["4000"] == pytest.approx(2048 / 0.60, abs=0.1)
+    assert rec["knee_surface"] == knee
+    assert rec["warm_compiles_across_grid"] == 0
+
+
+def test_serve_fleet_record_withholds_on_warm_compiles():
+    """The surface's claim IS the shared envelope executable: any
+    compile during the measured grid withholds the whole record,
+    plausible timings or not — the _geo_record discipline."""
+    rec = bench._serve_fleet_record(
+        _fleet_cells(), [], 2, [], {"devices": 1}
+    )
+    assert "error" in rec and "one-envelope-executable" in rec["error"]
+    assert "value" not in rec
+    assert rec["cells"][0]["lanes"] == 1  # raw cells kept
+
+
+def test_serve_fleet_record_withholds_on_parity_failure():
+    """A 1-lane zero-load fleet run diverging from closed-loop run()
+    means the lane program forked the protocol — the record is
+    withheld NAMING the failure, never published with asterisks."""
+    rec = bench._serve_fleet_record(
+        _fleet_cells(), [], 0,
+        ["1-lane zero-load fleet serve != closed-loop run() (sha256)"],
+        {"devices": 1},
+    )
+    assert "error" in rec and "zero-load parity" in rec["error"]
+    assert "sha256" in rec["error"]
+    assert "value" not in rec
+
+
+def test_serve_fleet_record_withholds_implausible_cell():
+    """A lying cell timing (64 lanes x 1 GiB of loop state x 1000
+    rounds in a microsecond) withholds the record naming the
+    (lanes, rate) cell — no roofline-clamped surface entry is ever
+    published."""
+    cells = _fleet_cells() + [{
+        "lanes": 64, "rate_milli": 128_000, "wall_s": 1e-6,
+        "rounds": 1000, "decided": 4096, "state_bytes": 1 << 30,
+        "sustained": False,
+    }]
+    rec = bench._serve_fleet_record(cells, [], 0, [], {"devices": 1})
+    assert "error" in rec and "roofline" in rec["error"]
+    assert "lanes=64" in rec["error"] and "128000" in rec["error"]
+    assert "value" not in rec
+
+
 def test_member_record_publishes_with_parity_and_host_block():
     """The membership host-vs-device record: per-seed sha parity and
     plausible timings publish the DEVICE rate (slowest run) with the

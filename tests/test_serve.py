@@ -155,6 +155,78 @@ def test_arrival_plan_rejects_too_narrow_width():
         plan.block(0, plan.max_block - 1)
 
 
+def test_realism_arrival_builders_deterministic():
+    """The heavy-tailed / bursty / diurnal builders: deterministic
+    per seed, sorted int32, seed-sensitive, and each exhibiting its
+    shape on a pinned draw (the draws are deterministic, so the shape
+    assertions are exact, not statistical)."""
+    for name in ("pareto", "bursty", "diurnal"):
+        f = arrv.ARRIVAL_BUILDERS[name]
+        a, b = f(96, 2000, 5), f(96, 2000, 5)
+        assert (a == b).all() and a.dtype == np.int32
+        assert (np.diff(a) >= 0).all()
+        assert (f(96, 2000, 6) != a).any(), name
+        # the shared-signature contract: n_values=0 is an empty
+        # stream, never a crash
+        assert len(f(0, 2000, 5)) == 0, name
+    # pareto: heavy tail — the largest gap dwarfs the mean gap (20x+
+    # on this pinned draw; the same-seed exponential peaks at ~6x)
+    gaps = np.diff(arrv.pareto_rounds(96, 200, 5))
+    assert gaps.max() > 20 * (1000 // 200)
+    assert gaps.max() > 2 * np.diff(arrv.poisson_rounds(96, 200, 5)).max()
+    # bursty: values share arrival rounds in bursts
+    br = arrv.bursty_rounds(96, 2000, 5, burst=8)
+    assert len(np.unique(br)) < len(br) // 2
+    # diurnal: the peak half-period carries more arrivals than the
+    # trough half (rate swings sinusoidally)
+    dr = arrv.diurnal_rounds(256, 2000, 5, period=512, depth=0.8)
+    phase = (dr % 512) < 256
+    assert phase.sum() > (~phase).sum()
+    with pytest.raises(ValueError, match="alpha"):
+        arrv.pareto_rounds(8, 1000, 0, alpha=1.0)
+    with pytest.raises(ValueError, match="burst"):
+        arrv.bursty_rounds(8, 1000, 0, burst=0)
+    with pytest.raises(ValueError, match="depth"):
+        arrv.diurnal_rounds(8, 1000, 0, depth=1.0)
+    for name in ("pareto", "bursty", "diurnal"):
+        with pytest.raises(ValueError, match="immediate_rounds"):
+            arrv.ARRIVAL_BUILDERS[name](8, 0, 0)
+
+
+def test_ingest_stamps_defeat_coordinated_omission():
+    """Acceptance pin for the realism axis: latency is judged from
+    INGEST-time stamps, not dispatch-time.  Values arriving just
+    AFTER a window boundary stall a full admission window before the
+    next upload (the mid-run stall: R-1 rounds of waiting the server
+    never sees as work); a coordinated-omission twin that stamps them
+    at their dispatch round runs the IDENTICAL trajectory but reports
+    every latency exactly that stall shorter.  The harness must
+    charge the wait: same decisions, whole histogram shifted, max
+    latency exactly +stall.  Shares the module's one executable."""
+    cfg = _cfg()
+    stall = R_WINDOW - 1
+    # true arrivals: 1 past each boundary; the CO twin quantizes each
+    # to its admission (dispatch) round — same admission blocks, so
+    # bit-identical protocol trajectories
+    true_arrs = [
+        np.asarray([j * R_WINDOW + 1 for j in range(10)], np.int32)
+        for _ in range(2)
+    ]
+    co_arrs = [a + stall for a in true_arrs]
+    a = _serve(cfg, true_arrs)
+    b = _serve(cfg, co_arrs)
+    assert (a.chosen_vid == b.chosen_vid).all()
+    assert (a.chosen_ballot == b.chosen_ballot).all()
+    assert a.decided_values == b.decided_values == 20
+    # every value's latency shifts by exactly the stall
+    assert a.latency_max == b.latency_max + stall
+    # the ingest-stamped distribution strictly dominates the CO twin
+    ha = np.cumsum(a.summary["latency_hist"])
+    hb = np.cumsum(b.summary["latency_hist"])
+    assert (ha <= hb).all() and (ha < hb).any()
+    assert a.p50 >= b.p50
+
+
 # ---------------- device-side admission + stamping ----------------
 
 

@@ -1,0 +1,397 @@
+"""Fleet serving (tpu_paxos/serve/fleet.py).
+
+The load-bearing contract is SINGLE-LANE PARITY: every lane of a
+fleet serve dispatch must be decision-log sha256-IDENTICAL to the
+single-stream harness (``serve/harness.serve_run``) on the same
+(cfg, stream, seed) at the same dispatch granularity — the lane
+program is the single driver's window vmapped, and vmapping may not
+perturb the protocol.  Alongside: the on-device per-lane SLO verdict
+is a conservative superset of the host judge (only breaching lanes
+pay the series transfer; the host names breach windows per
+(lane, region)), the per-region windowed latency series reduced on
+device equal the single harness's post-clock host twin, warm
+dispatches of a cached envelope cost zero XLA compiles, and the
+shard_map lane tile is bitwise-identical to the unmeshed vmap.
+
+Engine-cell budget: the module shares ONE fleet executable (the
+2-lane, S=2, K=10 shape below) across every fast engine cell, and
+reuses test_serve.py's module geometry so the single-run parity twins
+hit the serve driver's already-warm ``window_for`` cache.  The 8-lane
+heterogeneous grid and the mesh tile pay their own executables and
+ride the slow tier; their fast coverage is the 2-lane parity cell and
+the crafted-verdict cells here.
+"""
+
+import dataclasses
+import hashlib
+
+import numpy as np
+import pytest
+
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.replay.decision_log import decision_log
+from tpu_paxos.serve import arrivals as arrv
+from tpu_paxos.serve import fleet as sfl
+from tpu_paxos.serve import harness as sh
+from tpu_paxos.telemetry import recorder as telem
+
+# test_serve.py's module geometry: the single-run twins reuse its
+# cached window builder (window_for keys ignore the seed), so parity
+# cells cost fleet compiles only.
+WL = [np.arange(0, 10, dtype=np.int32), np.arange(20, 30, dtype=np.int32)]
+R_WINDOW = 8
+S_DISPATCH = 2
+ADMIT_W = 10
+
+
+def _cfg(seed=3):
+    return SimConfig(
+        n_nodes=3, n_instances=48, proposers=(0, 1), seed=seed,
+        max_rounds=4000,
+        faults=FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2),
+    )
+
+
+def _sha(chosen_vid, chosen_ballot):
+    text = decision_log(
+        chosen_vid, chosen_ballot, stride=30, n_instances=len(chosen_vid)
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _lane_at_rate(rate_milli, aseed, seed):
+    """One tenant stream of the module workload at an offered rate
+    (0 = offered-load-∞), arrival order per proposer preserved."""
+    if rate_milli <= 0:
+        rounds = arrv.immediate_rounds(20)
+    else:
+        rounds = arrv.poisson_rounds(20, rate_milli, aseed)
+    arrs = [np.sort(rounds[0::2]), np.sort(rounds[1::2])]
+    return sfl.ServeLane(WL, arrs, seed)
+
+
+def _fleet(cfg, lanes, **kw):
+    kw.setdefault("rounds_per_window", R_WINDOW)
+    kw.setdefault("windows_per_dispatch", S_DISPATCH)
+    kw.setdefault("admit_width", ADMIT_W)
+    return sfl.serve_fleet_run(cfg, lanes, **kw)
+
+
+def _serve_twin(cfg, lane, **kw):
+    kw.setdefault("rounds_per_window", R_WINDOW)
+    kw.setdefault("windows_per_dispatch", S_DISPATCH)
+    kw.setdefault("admit_width", ADMIT_W)
+    return sh.serve_run(
+        dataclasses.replace(cfg, seed=lane.seed), lane.workload,
+        lane.arrivals, **kw,
+    )
+
+
+# ---------------- single-lane parity (THE contract) ----------------
+
+
+def test_single_lane_parity_two_lane_heterogeneous():
+    """Fast-tier parity cell: a 2-lane heterogeneous-rate dispatch
+    (distinct arrival processes AND distinct engine seeds) is
+    decision-log sha256-identical PER LANE to the single-stream
+    harness — the full 8-lane grid rides the slow tier
+    (test_single_lane_parity_eight_lane_grid)."""
+    cfg = _cfg()
+    lanes = [_lane_at_rate(1500, 7, 3), _lane_at_rate(4000, 8, 4)]
+    rep = _fleet(cfg, lanes)
+    assert rep.done and rep.backlog == 0
+    for li, ln in enumerate(lanes):
+        single = _serve_twin(cfg, ln)
+        cv, cb = rep.lane_chosen(li)
+        assert _sha(cv, cb) == _sha(
+            single.chosen_vid, single.chosen_ballot
+        ), f"lane {li}"
+        assert int(rep.decided[li]) == single.decided_values
+        # the lane's windowed series equals the single run's too (the
+        # recorder rode the same donated loop state)
+        lw = rep.lane_summary(li)["windows"]
+        assert lw["lat_hist"] == single.windows["lat_hist"]
+        assert lw["decided"] == single.windows["decided"]
+
+
+def test_single_lane_region_series_match_host_twin():
+    """The on-device per-region windowed latency series of a fleet
+    lane equal the single harness's post-clock host recomputation
+    (recorder.region_window_hist_host) — and partition the global
+    windowed histogram.  Shares the module's 2-lane executable-shape
+    with the cell above... but regions ride runtime inputs, so this
+    is the SAME executable, not a new compile."""
+    cfg = _cfg()
+    rmap = np.asarray([0, 1, 0], np.int32)  # proposer 0 -> us, 1 -> eu
+    lanes = [_lane_at_rate(1500, 7, 3), _lane_at_rate(4000, 8, 4)]
+    rep = _fleet(cfg, lanes, region_map=rmap, region_names=("us", "eu"))
+    for li, ln in enumerate(lanes):
+        single = _serve_twin(
+            cfg, ln, region_map=rmap, region_names=("us", "eu")
+        )
+        rw = rep.lane_region_windows(li)
+        assert (rw == single.region_windows).all(), f"lane {li}"
+        # the per-region series partition the global one
+        lw = rep.lane_summary(li)["windows"]
+        assert rw.sum(axis=0).tolist() == lw["lat_hist"]
+        # both declared regions saw traffic (proposers split us/eu)
+        assert rw[0].sum() > 0 and rw[1].sum() > 0
+
+
+@pytest.mark.slow
+def test_single_lane_parity_eight_lane_grid():
+    """The acceptance grid: an 8-lane heterogeneous-rate stack —
+    fast-tier small cell (two zero-load lanes = offered-load-∞, a
+    trickle tier, a bursty-arrival lane, and a fast tier) — each lane
+    decision-log sha256-identical to its single-run twin.  Fast-tier
+    coverage: test_single_lane_parity_two_lane_heterogeneous (2-lane
+    cell, same program at a smaller lane shape)."""
+    cfg = _cfg()
+    lanes = []
+    for li, rm in enumerate([0, 0, 800, 1500, 1500, 4000, 8000, 16000]):
+        ln = _lane_at_rate(rm, 20 + li, 30 + li)
+        lanes.append(ln)
+    # one bursty-arrival lane (the realism axis through the fleet)
+    rounds = arrv.bursty_rounds(20, 2000, seed=5, burst=4)
+    lanes[4] = sfl.ServeLane(
+        WL, [np.sort(rounds[0::2]), np.sort(rounds[1::2])], 34
+    )
+    rep = _fleet(cfg, lanes)
+    assert rep.done and rep.backlog == 0
+    for li, ln in enumerate(lanes):
+        single = _serve_twin(cfg, ln)
+        cv, cb = rep.lane_chosen(li)
+        assert _sha(cv, cb) == _sha(
+            single.chosen_vid, single.chosen_ballot
+        ), f"lane {li}"
+        assert int(rep.decided[li]) == single.decided_values
+
+
+# ---------------- the on-device SLO verdict ----------------
+
+
+def _host_breach_lanes(hists, region_hists, slo, region_names):
+    """The host judge's breach set over a crafted stack — the
+    authority the device verdict must be a superset of."""
+    out = []
+    for i in range(hists.shape[0]):
+        v = sh.slo_windows(
+            {"window_rounds": 32, "lat_hist": hists[i]},
+            slo, region_series=region_hists[i],
+            region_names=region_names,
+        )
+        breach = bool(v["breach_windows"]) or any(
+            r["breach_windows"] for r in v.get("regions", {}).values()
+        )
+        out.append(breach)
+    return np.asarray(out)
+
+
+def test_device_slo_verdict_superset_of_host_judge():
+    """The transfer gate: every lane the host judge would flag (incl.
+    via a per-region series, incl. a burn rate landing EXACTLY on the
+    threshold) must be device-flagged — a missed flag would silently
+    hide a breach.  Crafted [lanes, W, B] stacks, no engine."""
+    import jax.numpy as jnp
+
+    w, b = telem.NUM_WINDOWS, telem.NUM_LAT_BUCKETS
+    r = telem.NUM_REGIONS
+    slo = sh.ServeSLO(
+        latency_rounds=16, budget_milli=250, regions=(("us", 8),)
+    )
+    lanes = 5
+    hists = np.zeros((lanes, w, b), np.int64)
+    rws = np.zeros((lanes, r, w, b), np.int64)
+    # lane 0: clean (all fast)
+    hists[0, 0, 1] = 40
+    # lane 1: global breach (half the window past 16 rounds)
+    hists[1, 2, 1] = 20
+    hists[1, 2, 6] = 20
+    # lane 2: burn EXACTLY at threshold (10 bad of 40 at budget 250
+    # -> burn 1.0) — the boundary the BURN_EPS margin exists for
+    hists[2, 3, 1] = 30
+    hists[2, 3, 6] = 10
+    # lane 3: global green, but region 'us' (8-round budget) breaches
+    # on its OWN series
+    hists[3, 1, 2] = 40  # latency (2, 4] — fine globally
+    rws[3, 0, 1, 4] = 40  # us traffic at (8, 16] — all bad for us
+    # lane 4: clean, with benign region traffic
+    hists[4, 0, 1] = 40
+    rws[4, 0, 0, 1] = 40
+    for i in range(lanes):
+        if not rws[i].any():
+            rws[i, 0] = hists[i]  # regions partition the global series
+    host = _host_breach_lanes(hists, rws, slo, ("us",))
+    slo_args = sfl._slo_args(slo, ("us",))
+    dev = np.asarray(sfl._slo_breach(
+        jnp.asarray(hists, jnp.int32), jnp.asarray(rws, jnp.int32),
+        *[jnp.asarray(x) for x in slo_args],
+    ))
+    assert host.tolist() == [False, True, True, True, False]
+    # superset: no host-flagged lane is ever device-missed
+    assert (dev | ~host).all(), (dev, host)
+    # and on this stack the verdicts agree exactly (the margin only
+    # admits extra flags within rounding epsilon of the threshold)
+    assert dev.tolist() == host.tolist()
+
+
+def test_slo_args_inert_and_fallback_thresholds():
+    b = telem.NUM_LAT_BUCKETS
+    k, rk, budget, burn = sfl._slo_args(None, ())
+    assert int(k) == b and (rk == b).all()
+    slo = sh.ServeSLO(
+        latency_rounds=16, budget_milli=100,
+        regions=(("us", 8), ("ap", 64)),
+    )
+    # 'us' has a series slot; 'ap' does not and folds into the global
+    # bucket index (min — conservative)
+    k, rk, budget, burn = sfl._slo_args(slo, ("us",))
+    import bisect
+
+    k_us = bisect.bisect_right(telem.LAT_EDGES, 8)
+    k_ap = bisect.bisect_right(telem.LAT_EDGES, 64)
+    k_g = bisect.bisect_right(telem.LAT_EDGES, 16)
+    assert int(rk[0]) == k_us and (rk[1:] == b).all()
+    assert int(k) == min(k_g, k_ap)
+    assert int(budget) == 100 and int(burn) == 1000
+
+
+def test_breaching_lanes_only_confirmed_and_named_per_region():
+    """Engine cell (module executable): an SLO fleet where the
+    on-device verdict flags breaching lanes; the report's ``slo``
+    dict holds host-confirmed verdicts for EXACTLY the flagged lanes,
+    with per-(lane, region) breach windows judged on each region's
+    OWN series."""
+    cfg = _cfg()
+    # lane 0: trickle + a 6-value burst at round 128 (test_serve's
+    # mid-run breach shape); lane 1: the same trickle without the
+    # burst
+    burst = [
+        np.asarray(sorted([i * 40 for i in range(7)] + [128] * 3),
+                   np.int32)
+        for _ in range(2)
+    ]
+    calm = [np.asarray([i * 40 for i in range(10)], np.int32)
+            for _ in range(2)]
+    lanes = [sfl.ServeLane(WL, burst, 3), sfl.ServeLane(WL, calm, 3)]
+    rmap = np.asarray([0, 1, 0], np.int32)
+    slo = sh.ServeSLO(
+        latency_rounds=16, budget_milli=400, regions=(("us", 16),)
+    )
+    rep = _fleet(cfg, lanes, slo=slo, region_map=rmap,
+                 region_names=("us", "eu"))
+    assert rep.done and rep.backlog == 0
+    flagged = set(int(i) for i in np.flatnonzero(rep.breach))
+    assert rep.slo is not None
+    # confirmed verdicts exist for exactly the flagged lanes — the
+    # unflagged lanes never paid the series transfer
+    assert set(rep.slo) == flagged
+    # the burst lane is flagged, its burst bucket named, and its
+    # region verdict judged on the region's OWN series
+    assert 0 in flagged
+    v = rep.slo[0]
+    assert 4 in v["breach_windows"]
+    assert v["regions"]["us"]["series"] == "region"
+    # monitoring saw it mid-run
+    assert rep.first_breach_dispatch[0] is not None
+    assert rep.first_breach_dispatch[0] <= rep.dispatches
+
+
+# ---------------- envelope cache + zero warm compiles ----------------
+
+
+def test_envelope_cache_identity_and_schedule_rejection():
+    from tpu_paxos.core import faults as fltm
+    from tpu_paxos.fleet import envelope as envm
+
+    cfg = _cfg()
+    _, _, _, c = simm.prepare_queues(cfg, WL)
+    r1 = envm.serve_fleet_for(cfg, c, 30, R_WINDOW, window_rounds=32)
+    r2 = envm.serve_fleet_for(cfg, c, 30, R_WINDOW, window_rounds=32)
+    assert r1 is r2
+    # seeds are runtime data: a different-seed cfg shares the runner
+    r3 = envm.serve_fleet_for(
+        dataclasses.replace(cfg, seed=99), c, 30, R_WINDOW,
+        window_rounds=32,
+    )
+    assert r3 is r1
+    assert envm.serve_fleet_for(
+        cfg, c, 30, R_WINDOW, window_rounds=64
+    ) is not r1
+    sched_cfg = dataclasses.replace(
+        cfg, faults=dataclasses.replace(
+            cfg.faults,
+            schedule=fltm.FaultSchedule((fltm.pause(1, 3, 0),)),
+        ),
+    )
+    with pytest.raises(ValueError, match="no fault schedule"):
+        envm.serve_fleet_for(sched_cfg, c, 30, R_WINDOW, window_rounds=32)
+
+
+def test_warm_dispatches_cost_zero_compiles(compile_census):
+    """The envelope claim live: after the module's first 2-lane
+    dispatch warmed the executable, a fresh fleet run at DIFFERENT
+    rates, seeds, SLO thresholds, and region maps costs zero XLA
+    compiles — they are all runtime data of the one cached program."""
+    cfg = _cfg()
+    # identical shapes to the warm cells above; different everything
+    # else
+    lanes = [_lane_at_rate(2500, 17, 13), _lane_at_rate(6000, 18, 14)]
+    before = compile_census.engine_counts.get("serve_fleet", 0)
+    rep = _fleet(
+        cfg, lanes,
+        slo=sh.ServeSLO(latency_rounds=32, budget_milli=200),
+        region_map=np.asarray([1, 0, 1], np.int32),
+        region_names=("us", "eu"),
+    )
+    assert rep.done
+    assert compile_census.engine_counts.get("serve_fleet", 0) == before
+
+
+# ---------------- shard_map lane tile ----------------
+
+
+@pytest.mark.slow
+def test_mesh_tile_bitwise_parity():
+    """The shard_map lane tile (2 of the conftest's 8 virtual CPU
+    devices) produces bitwise-identical per-lane state, decisions,
+    and breach vectors to the unmeshed vmap — lanes are independent,
+    so the tile is pure placement.  Slow tier: the tiled program is
+    its own executable; fast coverage is the unmeshed module cells
+    (same lane program) + fleet/runner's fast mesh-parity pin."""
+    import jax
+
+    from tpu_paxos.parallel import mesh as pmesh
+
+    cfg = _cfg()
+    lanes = [_lane_at_rate(1500, 7, 3), _lane_at_rate(4000, 8, 4)]
+    slo = sh.ServeSLO(latency_rounds=16, budget_milli=400)
+    rep = _fleet(cfg, lanes, slo=slo)
+    mesh = pmesh.make_instance_mesh(2)
+    assert mesh.size == 2
+    rep_m = _fleet(cfg, lanes, slo=slo, mesh=mesh)
+    for a, b in zip(jax.tree.leaves(rep.final), jax.tree.leaves(rep_m.final)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    assert (rep_m.breach == rep.breach).all()
+    assert (rep_m.decided == rep.decided).all()
+    # lanes that don't tile the mesh are rejected up front
+    with pytest.raises(ValueError, match="tile"):
+        _fleet(cfg, lanes[:1], mesh=mesh)
+
+
+# ---------------- validation ----------------
+
+
+def test_lane_validation_errors():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="at least one lane"):
+        sfl.serve_fleet_run(cfg, [])
+    with pytest.raises(ValueError, match="one value stream per proposer"):
+        sfl.serve_fleet_run(
+            cfg, [sfl.ServeLane([WL[0]], [np.zeros(10, np.int32)], 0)]
+        )
+    with pytest.raises(ValueError, match="admit_width"):
+        _fleet(cfg, [_lane_at_rate(0, 0, 3)], admit_width=2)
+    with pytest.raises(ValueError, match="window_rounds must be positive"):
+        sfl.ServeFleetRunner(cfg, 64, 30, R_WINDOW, 0)

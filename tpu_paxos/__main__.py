@@ -560,6 +560,15 @@ def main(argv=None) -> int:
 
         return texport.main(argv[1:])
     if argv and argv[0] == "serve":
+        if "--fleet" in argv[1:]:
+            # fleet serving: many tenant streams per dispatch
+            # (vmapped serve windows, on-device per-lane SLO
+            # verdicts); the (lanes x rates) surface (serve/fleet.py)
+            from tpu_paxos.serve import fleet as serve_fleet
+
+            return serve_fleet.main(
+                [a for a in argv[1:] if a != "--fleet"]
+            )
         # open-loop serving: Poisson / trace arrivals admitted
         # mid-flight through double-buffered dispatch windows;
         # latency-at-load + knee sweep (tpu_paxos/serve/)

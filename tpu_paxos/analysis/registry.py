@@ -41,6 +41,7 @@ AUDIT_PROVIDERS = (
     "tpu_paxos.fleet.member_runner",
     "tpu_paxos.analysis.modelcheck",
     "tpu_paxos.serve.driver",
+    "tpu_paxos.serve.fleet",
 )
 
 

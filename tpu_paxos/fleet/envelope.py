@@ -163,6 +163,89 @@ def runner_for(
     return runner
 
 
+def serve_envelope_key(
+    cfg: SimConfig,
+    queue_cap: int,
+    vid_bound: int,
+    rounds_per_window: int,
+    window_rounds: int,
+    mesh,
+) -> tuple:
+    """The hashable envelope of a serve FLEET — exactly the static
+    facts the compiled multi-tenant dispatch window depends on: the
+    cluster geometry, the protocol knobs, the compile-time i.i.d.
+    fault mix (serve engines take no schedule and no runtime knobs —
+    per-lane variation is arrivals/seeds/SLOs, all runtime data), the
+    queue capacity + ingest-table vid bound, the admission-window
+    span, the windowed-plane bucket width, and the device mesh.  Lane
+    count, windows-per-dispatch, and admit width are CALL SHAPES of
+    the cached callable, not key components — a whole
+    (lanes x offered-rates) sweep shares one cached runner.
+
+    The engine's compile-time facts come from the driver's ONE
+    authoritative list (``serve/driver.engine_static_key`` — also
+    ``window_for``'s key), so a new engine-build fact cannot land in
+    one cache key and miss the other."""
+    # importlib: keep the serve stack out of the replay-critical
+    # import closure (see serve_fleet_for)
+    import importlib
+
+    sdrv = importlib.import_module("tpu_paxos.serve.driver")
+    return (
+        "serve",
+        sdrv.engine_static_key(cfg),
+        int(queue_cap),
+        int(vid_bound),
+        int(rounds_per_window),
+        int(window_rounds),
+        _mesh_key(mesh),
+    )
+
+
+def serve_fleet_for(
+    cfg: SimConfig,
+    queue_cap: int,
+    vid_bound: int,
+    rounds_per_window: int,
+    *,
+    window_rounds: int,
+    mesh=None,
+):
+    """The shared compiled fleet-serving runner for this envelope
+    (``serve/fleet.ServeFleetRunner``), memoized in the same cache
+    the sim and membership envelopes share: every tenant mix, offered
+    rate, and SLO declaration of a geometry then costs dispatches,
+    not compiles (SLO thresholds are runtime inputs; lane count /
+    admit width are call shapes)."""
+    # importlib (the lazy-package idiom): the serve stack is NOT part
+    # of the replay-critical import closure — a static import here
+    # would pull serve's host harness (and its CLI imports) into the
+    # DET lint scope via harness/shrink.py -> this module
+    import importlib
+
+    sflt = importlib.import_module("tpu_paxos.serve.fleet")
+
+    if cfg.faults.schedule is not None:
+        # checked HERE like serve/driver.window_for: the key ignores
+        # the schedule, so a schedule-bearing cfg would otherwise HIT
+        # a warm cache and silently drop its correlated faults
+        raise ValueError(
+            "serve engines take no fault schedule (correlated-fault "
+            "serving rides the stress fleet envelope, not this driver)"
+        )
+    key = serve_envelope_key(
+        cfg, queue_cap, vid_bound, rounds_per_window, window_rounds, mesh
+    )
+    runner = _CACHE.get(key)
+    if runner is None:
+        runner = sflt.ServeFleetRunner(
+            cfg, queue_cap, vid_bound, rounds_per_window,
+            window_rounds, mesh=mesh,
+        )
+        _CACHE[key] = runner
+    return runner
+
+
 def member_envelope_key(
     n_nodes: int,
     n_instances: int,

@@ -12,11 +12,14 @@ time.
 
 Submodules are lazily re-exported (PEP 562), mirroring ``fleet``:
 ``driver`` owns the jitted dispatch-window surface (an audit
-provider), ``harness`` the host-side ingestion loop + CLI, and
-``arrivals`` the arrival processes (pure numpy, jax-free).
+provider), ``harness`` the host-side ingestion loop + CLI,
+``arrivals`` the arrival processes (pure numpy, jax-free), and
+``fleet`` the multi-tenant serve lanes (the dispatch window vmapped
+over ``[lanes]`` tenant streams with on-device per-lane SLO
+verdicts — its own audit provider).
 """
 
-_SUBMODULES = ("arrivals", "driver", "harness")
+_SUBMODULES = ("arrivals", "driver", "fleet", "harness")
 
 
 def __getattr__(name):

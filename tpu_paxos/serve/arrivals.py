@@ -50,6 +50,117 @@ def poisson_rounds(n_values: int, rate_milli: int, seed: int) -> np.ndarray:
     return np.floor(np.cumsum(gaps)).astype(np.int32)
 
 
+def pareto_rounds(
+    n_values: int, rate_milli: int, seed: int, alpha: float = 1.5
+) -> np.ndarray:
+    """Heavy-tailed arrivals: Lomax (Pareto-II) inter-arrival gaps
+    with tail index ``alpha`` scaled to the same MEAN gap as
+    :func:`poisson_rounds` at ``rate_milli`` (``alpha`` must exceed 1
+    or the mean diverges) — long quiet stretches punctuated by
+    clustered arrivals, the classic open-internet traffic shape the
+    exponential's memorylessness cannot produce.  Deterministic per
+    (n_values, rate_milli, seed, alpha)."""
+    if rate_milli <= 0:
+        raise ValueError(
+            f"rate_milli must be positive (got {rate_milli}); use "
+            "immediate_rounds() for the offered-load-∞ limit"
+        )
+    if alpha <= 1.0:
+        raise ValueError(
+            f"alpha must exceed 1 (got {alpha}); at alpha <= 1 the "
+            "Lomax mean diverges and rate_milli means nothing"
+        )
+    rng = np.random.default_rng((0x50415245, int(seed)))
+    mean = 1000.0 / rate_milli
+    # Lomax mean = scale / (alpha - 1)  =>  scale pins the offered rate
+    gaps = rng.pareto(alpha, size=int(n_values)) * (mean * (alpha - 1.0))
+    return np.floor(np.cumsum(gaps)).astype(np.int32)
+
+
+def bursty_rounds(
+    n_values: int, rate_milli: int, seed: int, burst: int = 8
+) -> np.ndarray:
+    """Bursty arrivals: values arrive in geometric-size bursts (mean
+    ``burst`` values sharing ONE arrival round) separated by
+    exponential gaps scaled so the long-run offered rate is still
+    ``rate_milli`` values per 1000 rounds — the batched-upstream shape
+    (a replicating shard, a client-side retry storm) that stresses
+    admission-window quantization hardest.  Deterministic per
+    (n_values, rate_milli, seed, burst)."""
+    if rate_milli <= 0:
+        raise ValueError(
+            f"rate_milli must be positive (got {rate_milli}); use "
+            "immediate_rounds() for the offered-load-∞ limit"
+        )
+    if burst < 1:
+        raise ValueError(f"burst must be >= 1 (got {burst})")
+    rng = np.random.default_rng((0x42555253, int(seed)))
+    n = int(n_values)
+    sizes = rng.geometric(1.0 / burst, size=n)  # mean `burst`, >= 1
+    # truncate the burst train at exactly n values (sizes are >= 1,
+    # so n bursts always cover n values)
+    counts = np.clip(n - (np.cumsum(sizes) - sizes), 0, sizes)
+    keep = counts > 0
+    sizes, counts = sizes[keep], counts[keep]
+    # burst START gaps: mean burst arrivals per gap at the target rate
+    gaps = rng.exponential(1000.0 / rate_milli * burst, size=len(sizes))
+    starts = np.floor(np.cumsum(gaps)).astype(np.int64)
+    return np.repeat(starts, counts)[:n].astype(np.int32)
+
+
+def diurnal_rounds(
+    n_values: int, rate_milli: int, seed: int,
+    period: int = 2048, depth: float = 0.8,
+) -> np.ndarray:
+    """Diurnal arrivals: an inhomogeneous Poisson process whose rate
+    swings sinusoidally around ``rate_milli`` (peak ``1 + depth``,
+    trough ``1 - depth`` of the mean) with period ``period`` rounds —
+    the day/night load curve a fleet controller must ride.  Sampled
+    exactly by time-warping a unit-rate process through the inverse
+    integrated-rate function (bisection on the monotone cumulative
+    rate; no thinning, so the draw count is deterministic).
+    Deterministic per (n_values, rate_milli, seed, period, depth)."""
+    if rate_milli <= 0:
+        raise ValueError(
+            f"rate_milli must be positive (got {rate_milli}); use "
+            "immediate_rounds() for the offered-load-∞ limit"
+        )
+    if not (0.0 <= depth < 1.0):
+        raise ValueError(f"depth must be in [0, 1) (got {depth})")
+    if period < 2:
+        raise ValueError(f"period must be >= 2 (got {period})")
+    rng = np.random.default_rng((0x44495552, int(seed)))
+    base = rate_milli / 1000.0  # values per round
+    if int(n_values) == 0:
+        return np.zeros((0,), np.int32)
+    unit = np.cumsum(rng.exponential(1.0, size=int(n_values)))
+
+    def cum_rate(t):
+        # integral of base * (1 + depth * sin(2 pi t / period))
+        w = 2.0 * np.pi / period
+        return base * (t + depth * (1.0 - np.cos(w * t)) / w)
+
+    lo = np.zeros_like(unit)
+    hi = np.full_like(unit, unit[-1] / (base * (1.0 - depth)) + period)
+    for _ in range(64):  # bisection to well under round resolution
+        mid = 0.5 * (lo + hi)
+        below = cum_rate(mid) < unit
+        lo = np.where(below, mid, lo)
+        hi = np.where(below, hi, mid)
+    return np.floor(hi).astype(np.int32)
+
+
+#: Name -> builder map for the CLI's --arrivals flag (every builder
+#: shares the (n_values, rate_milli, seed) signature; extra shape
+#: knobs keep their defaults there).
+ARRIVAL_BUILDERS = {
+    "poisson": poisson_rounds,
+    "pareto": pareto_rounds,
+    "bursty": bursty_rounds,
+    "diurnal": diurnal_rounds,
+}
+
+
 def immediate_rounds(n_values: int) -> np.ndarray:
     """The offered-load-∞ limit: every value arrives at round 0 (all
     admitted in window 0 — the zero-load parity shape)."""

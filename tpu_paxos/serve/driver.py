@@ -206,6 +206,32 @@ def clear_cache() -> None:
     _CACHE.clear()
 
 
+def engine_static_key(cfg: SimConfig) -> tuple:
+    """THE compile-time facts of a serve engine build, as one hashable
+    tuple — the single source of truth shared by :func:`window_for`'s
+    cache key and the fleet serve envelope key
+    (``fleet/envelope.serve_envelope_key``).  A fact added to the
+    engine build MUST land here, or a changed config could HIT a warm
+    cache and silently run the wrong executable (exactly how
+    ``edges``/``delivery_cut`` were once missing from one of two
+    hand-duplicated lists)."""
+    return (
+        simm.seeded_wedge(),
+        cfg.n_nodes,
+        cfg.proposers,
+        cfg.n_instances,
+        cfg.assign_window,
+        cfg.max_rounds,
+        dataclasses.astuple(cfg.protocol),
+        (
+            cfg.faults.drop_rate, cfg.faults.dup_rate,
+            cfg.faults.min_delay, cfg.faults.max_delay,
+            cfg.faults.crash_rate,
+            cfg.faults.edges, bool(cfg.faults.delivery_cut),
+        ),
+    )
+
+
 def window_for(
     cfg: SimConfig, queue_cap: int, vid_bound: int, rounds_per_window: int,
     window_rounds: int = 0,
@@ -226,18 +252,7 @@ def window_for(
             "serving rides the fleet envelope, not this driver)"
         )
     key = (
-        simm.seeded_wedge(),
-        cfg.n_nodes,
-        cfg.proposers,
-        cfg.n_instances,
-        cfg.assign_window,
-        cfg.max_rounds,
-        dataclasses.astuple(cfg.protocol),
-        (
-            cfg.faults.drop_rate, cfg.faults.dup_rate,
-            cfg.faults.min_delay, cfg.faults.max_delay,
-            cfg.faults.crash_rate,
-        ),
+        engine_static_key(cfg),
         int(queue_cap),
         int(vid_bound),
         int(rounds_per_window),

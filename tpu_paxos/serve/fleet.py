@@ -1,0 +1,983 @@
+"""Fleet serving: many tenant value streams per dispatch — the serve
+driver's whole dispatch window vmapped over a ``[lanes]`` axis, with
+per-lane SLO verdicts reduced ON DEVICE.
+
+The PR-9 harness serves ONE value stream per process; production is
+millions of users spread over many tenant clusters, each with its own
+arrival process and SLO (ROADMAP item 2).  This module lifts the
+open-loop serve loop onto fleet lanes exactly the way ``fleet/runner``
+lifted the stress engine: the per-lane dispatch window — ingest-stamp
+scatter, ``admit_block`` queue append, ``rounds_per_window``
+recorder-armed engine rounds per sub-window, and the on-device
+summary epilogue — is ONE traced function ``vmap``-ed over stacked
+lane state, so a whole tenant fleet advances per XLA dispatch.  The
+per-lane :class:`~tpu_paxos.serve.driver.ServeLoopState` (engine
+state, recorder accumulators incl. the ``[W]`` windowed rings, ingest
+table) rides as ONE donated ``[lanes]``-stacked argument; per-lane
+``ArrivalPlan`` admission blocks upload as ``[lanes, S, P, K]``
+runtime data.  Lanes differ in arrivals, seeds, and SLOs — never in
+compiled program: ``fleet/envelope.serve_fleet_for`` memoizes one
+:class:`ServeFleetRunner` per serve envelope (geometry, protocol,
+i.i.d. knobs, queue/vid shapes, window spans), and lane count /
+windows-per-dispatch / admit width are call shapes of the one cached
+callable, so a whole (lanes x offered-rates) sweep costs one compile
+per lane-count shape and ZERO warm compiles across the grid
+(BENCH_serve_fleet.json pins it).
+
+The SLO monitor moves on device: each dispatch reduces every lane's
+windowed latency series (global AND per-region — see
+``telemetry/recorder.region_window_hist``) against runtime burn-rate
+thresholds to a ``[lanes]`` breach vector (:func:`_slo_breach`), so
+the per-dispatch host sync is four small vectors (done / round /
+decided / breach) and ONLY breaching lanes ever pay the windowed
+series transfer + the host judge that names their breach windows per
+(lane, region).  The device verdict is a conservative superset of the
+host judge (``BURN_EPS`` covers the judge's 3-decimal rounding), so a
+lane the host would flag is never silently skipped.
+
+Lane-for-lane the fleet is DECISION-LOG-IDENTICAL to single
+``serve/harness.serve_run`` executions of the same (cfg, stream,
+seed) at the same dispatch granularity — the engine build is the
+single driver's, and ``jax_threefry_partitionable`` makes the batched
+draws equal the per-lane draws (tests/test_serve_fleet.py pins the
+sha256 per lane on a heterogeneous-rate stack).  Scale-out mirrors
+``fleet/runner``: the lane axis tiles over a device mesh via
+``shard_map`` (lanes are independent — no collectives), bitwise
+parity pinned on the test conftest's virtual mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import dataclasses
+import json
+import sys
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.analysis import tracecount
+from tpu_paxos.config import FaultConfig, SimConfig
+from tpu_paxos.core import sim as simm
+from tpu_paxos.core import values as val
+from tpu_paxos.serve import arrivals as arrv
+from tpu_paxos.serve import driver as drv
+from tpu_paxos.serve import harness as sh
+from tpu_paxos.telemetry import recorder as telem
+from tpu_paxos.utils import prng
+
+#: Margin subtracted from the burn threshold by the ON-DEVICE verdict
+#: (:func:`_slo_breach`).  The host judge (harness._judge_series)
+#: rounds each window's burn rate to 3 decimals before comparing, so
+#: a window at burn >= burn_breach - 0.0005 can round UP into a named
+#: breach; the device verdict must flag every such lane (it is the
+#: transfer gate — a missed flag would silently hide a breach), so it
+#: compares against the threshold minus this margin.  The cost of the
+#: asymmetry is one spurious lane transfer within the margin, which
+#: the host judge then renders as a no-breach verdict.
+BURN_EPS = 5e-4
+
+
+class ServeLane(NamedTuple):
+    """One tenant stream: per-proposer vid sequences, their arrival
+    rounds (nondecreasing per proposer — the queue is FIFO), and the
+    lane's PRNG seed (the single-run twin is ``serve_run`` on
+    ``dataclasses.replace(cfg, seed=seed)``)."""
+
+    workload: list
+    arrivals: list
+    seed: int
+
+
+def _slo_args(slo, region_names):
+    """Runtime SLO-threshold arrays for one dispatch: ``(k, region_k,
+    budget_milli, burn_milli)``.  Thresholds are RUNTIME inputs of the
+    compiled dispatch, so every SLO declaration (and none at all)
+    rides one executable: ``slo=None`` lowers to inert thresholds
+    (bucket index = NUM_LAT_BUCKETS — nothing is ever bad).
+
+    A declared region missing from ``region_names`` has no per-region
+    series on device; its threshold folds into the GLOBAL series
+    bucket index (min — more buckets count as bad), keeping the device
+    verdict a superset of the host judge's global-series fallback."""
+    b = telem.NUM_LAT_BUCKETS
+    rk = np.full((telem.NUM_REGIONS,), b, np.int32)
+    if slo is None:
+        return (np.int32(b), rk, np.int32(1), np.int32(1000))
+    k = bisect.bisect_right(telem.LAT_EDGES, int(slo.latency_rounds))
+    names = tuple(region_names)
+    for name, lat in slo.regions:
+        kr = bisect.bisect_right(telem.LAT_EDGES, int(lat))
+        if name in names:
+            rk[names.index(name)] = kr
+        else:
+            k = min(k, kr)
+    return (
+        np.int32(k), rk,
+        np.int32(max(int(slo.budget_milli), 1)),
+        np.int32(round(float(slo.burn_breach) * 1000)),
+    )
+
+
+def _slo_breach(lat_hist, region_hist, slo_k, region_k, budget_milli,
+                burn_milli):
+    """The on-device per-lane SLO verdict: ``[lanes]`` bool — does any
+    window of the lane's global series (threshold bucket ``slo_k``) or
+    any region's own series (``region_k[r]``) burn at or above the
+    breach threshold?  Float32 on both sides of the device/host seam
+    (the host confirm judge uses the same expression), with the
+    comparison shifted by :data:`BURN_EPS` so the device flag is a
+    conservative superset of the host judge's rounded verdict.
+    ``lat_hist`` is ``[lanes, W, B]``; ``region_hist`` is
+    ``[lanes, R, W, B]``."""
+    b = lat_hist.shape[-1]
+    ar = jnp.arange(b, dtype=jnp.int32)
+    thresh = (
+        burn_milli.astype(jnp.float32) / jnp.float32(1000.0)
+        - jnp.float32(BURN_EPS)
+    )
+
+    def burns(hist, bad_mask):
+        tot = hist.sum(axis=-1)
+        bad = (hist * bad_mask).sum(axis=-1)
+        num = (bad * 1000).astype(jnp.float32)
+        den = (tot * budget_milli).astype(jnp.float32)
+        return (tot > 0) & (num >= thresh * den)
+
+    g = burns(lat_hist, (ar >= slo_k).astype(lat_hist.dtype))
+    rmask = (ar[None, :] >= region_k[:, None]).astype(region_hist.dtype)
+    r = burns(region_hist, rmask[None, :, None, :])
+    return g.any(axis=-1) | r.any(axis=(-1, -2))
+
+
+class ServeFleetRunner:
+    """Compile-once fleet serving front end for one serve envelope:
+    the jitted, vmapped (optionally shard_map-tiled) dispatch-window
+    program with the ``[lanes]``-stacked loop state donated.  ``run``
+    — the host loop — lives in :func:`serve_fleet_run`; this class
+    owns every jitted surface so the audit's unregistered-function
+    sweep covers the module (entry ``serve.fleet_window``).
+
+    The engine build is EXACTLY the single serve driver's
+    (``build_engine(cfg, queue_cap, vid_cap=0, telemetry=True,
+    window_rounds=ww)``), which is what makes a fleet lane
+    decision-log-identical to its ``serve_run`` twin."""
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        queue_cap: int,
+        vid_bound: int,
+        rounds_per_window: int,
+        window_rounds: int,
+        mesh=None,
+    ):
+        if cfg.faults.schedule is not None:
+            raise ValueError(
+                "serve engines take no fault schedule (correlated-fault "
+                "serving rides the fleet envelope, not this driver)"
+            )
+        ww = int(window_rounds)
+        if ww <= 0:
+            raise ValueError(
+                "fleet serving always rides the windowed plane (the "
+                "on-device SLO verdict reads it); window_rounds must "
+                "be positive"
+            )
+        self.cfg = cfg
+        self.queue_cap = int(queue_cap)
+        self.vid_bound = int(vid_bound)
+        self.rounds_per_window = int(rounds_per_window)
+        self.window_rounds = ww
+        self.mesh = mesh
+        round_fn = simm.build_engine(
+            cfg, self.queue_cap, vid_cap=0, telemetry=True, window_rounds=ww
+        )
+        r = self.rounds_per_window
+        v_bound = self.vid_bound
+
+        def lane(ss, root, admits, arrs, vid_region, rmap):
+            s = admits.shape[0]
+
+            def sub(i, carry):
+                st, tl, ingest = carry
+                admit, arr = admits[i], arrs[i]
+                # ingest-time stamping, exactly the single driver's
+                flat_v = admit.reshape(-1)
+                idx = jnp.where(
+                    (flat_v >= 0) & (flat_v < v_bound), flat_v, v_bound
+                )
+                ingest = ingest.at[idx].set(arr.reshape(-1), mode="drop")
+                st = simm.admit_block(st, admit)
+
+                def body(_, c):
+                    return round_fn(root, c[0], tele=c[1])
+
+                st, tl = jax.lax.fori_loop(0, r, body, (st, tl))
+                return drv.ServeLoopState(st, tl, ingest)
+
+            st, tl, ingest = jax.lax.fori_loop(
+                0, s, sub, drv.ServeLoopState(*ss)
+            )
+            adm = telem.serve_admit_rounds(ingest, st.met.chosen_vid)
+            base, wins = tl
+            summ = telem.summarize(
+                base._replace(admit_round=adm), st, 0, rmap
+            )
+            wsum = telem.summarize_windows(
+                wins, adm, st.met.chosen_vid, st.met.chosen_round, ww
+            )
+            rw = telem.region_window_hist(
+                adm, st.met.chosen_vid, st.met.chosen_round, vid_region, ww
+            )
+            return (
+                drv.ServeLoopState(st, tl, ingest),
+                st.done, st.t, summ, wsum, rw,
+            )
+
+        fl = jax.vmap(lane)
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import PartitionSpec as P
+
+            from tpu_paxos.parallel import mesh as pmesh
+
+            spec = P(pmesh.instance_axes(mesh))
+            fl = pmesh.shard_map(
+                fl, mesh, in_specs=(spec,) * 6, out_specs=(spec,) * 6
+            )
+
+        def dispatch(sss, roots, admits, arrs, vid_regions, rmaps,
+                     slo_k, region_k, budget_milli, burn_milli):
+            sss, done, t, summ, wsum, rw = fl(
+                sss, roots, admits, arrs, vid_regions, rmaps
+            )
+            breach = _slo_breach(
+                wsum.lat_hist, rw, slo_k, region_k, budget_milli,
+                burn_milli,
+            )
+            # real stamped values decided per lane (hist mass — the
+            # noop-fill-free count the harness's `decided` means)
+            decided = jnp.sum(summ.lat_hist, axis=-1)
+            return sss, done, t, decided, breach, summ, wsum, rw
+
+        self._fn = jax.jit(dispatch, donate_argnums=(0,))
+
+        def init_lane(pend, gate, tail, root):
+            st = simm.init_state(cfg, pend, gate, tail, root)
+            tele = (
+                telem.init_telemetry(
+                    cfg.n_instances, len(cfg.proposers), cfg.n_nodes
+                ),
+                telem.init_windows(),
+            )
+            ingest = jnp.full((v_bound,), val.NONE, jnp.int32)
+            return drv.ServeLoopState(st, tele, ingest)
+
+        self._init = jax.jit(jax.vmap(init_lane))
+
+
+@dataclasses.dataclass
+class ServeFleetReport:
+    """One fleet serve run's outcome.  The per-lane summaries, the
+    windowed series, and the per-region series stay ON DEVICE — the
+    per-dispatch sync was four ``[lanes]`` vectors, and ``slo`` holds
+    host-confirmed verdicts for the lanes the on-device monitor
+    flagged (only those paid the series transfer)."""
+
+    cfg: SimConfig
+    n_lanes: int
+    seeds: list
+    rounds_per_window: int
+    windows_per_dispatch: int
+    admit_width: int
+    window_rounds: int
+    dispatches: int
+    rounds: int
+    done: bool
+    n_values: list  # per-lane planned stream sizes
+    decided: np.ndarray  # [lanes] real stamped values decided
+    wall_seconds: float
+    breach: np.ndarray  # [lanes] bool — the final on-device verdict
+    first_breach_dispatch: list  # [lanes] 1-based dispatch | None
+    slo: dict | None  # {lane: slo_windows verdict} for flagged lanes
+    region_names: tuple
+    final: object  # device [lanes]-stacked ServeLoopState
+    summaries: object  # device [lanes] TelemetrySummary
+    windows: object  # device [lanes, W] WindowSummary
+    region_windows: object  # device [lanes, R, W, B] int32
+
+    @property
+    def decided_total(self) -> int:
+        return int(self.decided.sum())
+
+    @property
+    def backlog(self) -> int:
+        return int(sum(self.n_values)) - self.decided_total
+
+    @property
+    def values_per_sec(self) -> float:
+        """Aggregate sustained throughput across every lane — the
+        fleet's one clock served all of them."""
+        return self.decided_total / max(self.wall_seconds, 1e-9)
+
+    def lane_chosen(self, i: int):
+        """One lane's decision arrays (chosen_vid, chosen_ballot) —
+        the decision-log parity hand-off; transfers one lane."""
+        met = self.final.sim.met
+        return (
+            np.asarray(met.chosen_vid[i]),
+            np.asarray(met.chosen_ballot[i]),
+        )
+
+    def lane_summary(self, i: int) -> dict:
+        """One lane's flight-recorder summary dict (incl. the
+        windowed block) — transfers that lane only."""
+        one = jax.tree.map(lambda x: x[i], self.summaries)
+        wone = jax.tree.map(lambda x: x[i], self.windows)
+        return telem.summary_to_dict(one, wone, self.window_rounds)
+
+    def lane_region_windows(self, i: int) -> np.ndarray:
+        """One lane's ``[R, W, B]`` per-region windowed latency
+        histograms — transfers that lane only."""
+        return np.asarray(self.region_windows[i])
+
+
+def _check_lane(cfg: SimConfig, lane: ServeLane, li: int):
+    wl = [np.asarray(w, np.int32).reshape(-1) for w in lane.workload]
+    if len(wl) != len(cfg.proposers):
+        raise ValueError(
+            f"lane {li}: one value stream per proposer required"
+        )
+    return ServeLane(wl, list(lane.arrivals), int(lane.seed))
+
+
+def serve_fleet_run(
+    cfg: SimConfig,
+    lanes,
+    *,
+    rounds_per_window: int = sh.ROUNDS_PER_WINDOW,
+    windows_per_dispatch: int = sh.WINDOWS_PER_DISPATCH,
+    admit_width: int | None = None,
+    pipelined: bool = True,
+    window_rounds: int | None = None,
+    slo: sh.ServeSLO | None = None,
+    region_map=None,
+    region_names: tuple = (),
+    mesh=None,
+) -> ServeFleetReport:
+    """Serve a fleet of tenant streams open-loop to completion (or
+    the round budget): ``lanes[i]`` is a :class:`ServeLane` (or a
+    ``(workload, arrivals, seed)`` triple).  Every lane advances in
+    lockstep on the shared virtual clock — lanes whose plans end
+    early run decision-neutral drain windows, exactly like the single
+    harness past quiescence — and a 1-lane run is decision-log
+    sha256-identical to ``serve_run`` at the same dispatch
+    granularity.
+
+    ``slo`` arms the ON-DEVICE burn-rate monitor: each dispatch
+    reduces every lane's windowed series (and, with ``region_map`` +
+    ``region_names``, each region's OWN series) to a ``[lanes]``
+    breach vector, and only flagged lanes pay the series transfer +
+    the host judge that names breach windows per (lane, region).
+    ``mesh`` tiles the lane axis over devices via ``shard_map``
+    (lane count must tile the mesh)."""
+    from tpu_paxos.fleet import envelope as envm
+
+    lanes = [
+        _check_lane(cfg, ln if isinstance(ln, ServeLane) else ServeLane(*ln), i)
+        for i, ln in enumerate(lanes)
+    ]
+    if not lanes:
+        raise ValueError("at least one lane required")
+    n_lanes = len(lanes)
+    if mesh is not None and n_lanes % max(mesh.size, 1):
+        raise ValueError(
+            f"{n_lanes} lanes do not tile over {mesh.size} devices"
+        )
+    plans = [
+        arrv.ArrivalPlan(ln.workload, ln.arrivals, rounds_per_window)
+        for ln in lanes
+    ]
+    k = int(admit_width or max(p.max_block for p in plans))
+    if max(p.max_block for p in plans) > k:
+        raise ValueError(
+            f"admit_width {k} below this fleet's max block "
+            f"{max(p.max_block for p in plans)}"
+        )
+    s = int(windows_per_dispatch)
+    if s < 1:
+        raise ValueError("windows_per_dispatch must be >= 1")
+    if window_rounds is None:
+        window_rounds = sh.WINDOWS_PER_BUCKET * rounds_per_window
+    ww = int(window_rounds)
+    if slo is not None and not ww:
+        raise ValueError(
+            "the SLO monitor reads the windowed series; "
+            "window_rounds=0 disarms it"
+        )
+    # envelope shapes: queue capacity and vid bound cover every lane
+    # (capacity follows prepare_queues' proof per lane, so the bound
+    # over lanes keeps every lane clamp-free)
+    c = max(simm.prepare_queues(cfg, ln.workload)[3] for ln in lanes)
+    v_bound = max(drv.vid_bound_of(ln.workload) for ln in lanes)
+    runner = envm.serve_fleet_for(
+        cfg, c, v_bound, rounds_per_window,
+        window_rounds=ww, mesh=mesh,
+    )
+    p = len(cfg.proposers)
+    width = c + cfg.assign_window
+    pend = np.full((n_lanes, p, width), int(val.NONE), np.int32)
+    gate = np.full((n_lanes, p, width), int(val.NONE), np.int32)
+    tail = np.zeros((n_lanes, p), np.int32)
+    roots = jnp.stack([prng.root_key(ln.seed) for ln in lanes])
+    a = cfg.n_nodes
+    if region_map is None:
+        rmap = np.zeros((a,), np.int32)
+    else:
+        rmap = np.asarray(region_map, np.int32).reshape(a)
+    rmaps = np.broadcast_to(rmap, (n_lanes, a))
+    vid_regions = np.zeros((n_lanes, v_bound), np.int32)
+    for li, ln in enumerate(lanes):
+        for node, stream in zip(cfg.proposers, ln.workload):
+            vid_regions[li, stream] = rmap[node]
+    slo_args = tuple(
+        jnp.asarray(x) for x in _slo_args(slo, region_names)
+    )
+    n_disp_admit = max((pl.n_windows + s - 1) // s for pl in plans)
+    disp_cap = max(
+        cfg.round_budget // (rounds_per_window * s) + 1, n_disp_admit
+    )
+    empty = (
+        jnp.full((n_lanes, s, p, k), val.NONE, jnp.int32),
+        jnp.zeros((n_lanes, s, p, k), jnp.int32),
+    )
+
+    def super_block(d):
+        """Stack dispatch ``d``'s S admission windows for every lane
+        ([lanes, S, P, K]); lanes past their plan get empty rows."""
+        adm = np.stack([
+            np.stack([pl.block(d * s + i, k)[0] for i in range(s)])
+            for pl in plans
+        ])
+        arr = np.stack([
+            np.stack([pl.block(d * s + i, k)[1] for i in range(s)])
+            for pl in plans
+        ])
+        return jnp.asarray(adm), jnp.asarray(arr)
+
+    first_breach: list = [None] * n_lanes
+
+    def harvest(out):
+        # the one host sync per dispatch: four [lanes] vectors — the
+        # stop scalars, the decided counts, and the ON-DEVICE SLO
+        # verdict; the windowed series stay on device
+        done, t, decided, breach = (
+            np.asarray(out[0]), np.asarray(out[1]),  # paxlint: allow[JAX103] the harvest IS the per-dispatch sync point: four [lanes] vectors by design, double-buffered by the caller
+            np.asarray(out[2]), np.asarray(out[3]),
+        )
+        for i in np.flatnonzero(breach):
+            if first_breach[int(i)] is None:
+                first_breach[int(i)] = harvested + 1
+        return done, t, decided, breach
+
+    pending = None
+    last_done = np.zeros((n_lanes,), bool)
+    last_t = np.zeros((n_lanes,), np.int32)
+    last_decided = np.zeros((n_lanes,), np.int32)
+    last_breach = np.zeros((n_lanes,), bool)
+    last_dev = None
+    d = harvested = 0
+    t0 = time.perf_counter()  # paxlint: allow[DET001] wall metric only; never reaches artifacts
+    with tracecount.engine_scope("serve_fleet"):
+        sss = runner._init(
+            jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), roots
+        )
+        while True:
+            blk = super_block(d) if d < n_disp_admit else empty
+            out = runner._fn(
+                sss, roots, *blk, jnp.asarray(vid_regions),
+                jnp.asarray(rmaps), *slo_args,
+            )
+            sss = out[0]
+            d += 1
+            if pipelined:
+                if pending is not None:
+                    last_done, last_t, last_decided, last_breach = (
+                        harvest(pending[:4])
+                    )
+                    last_dev = pending[4:]
+                    harvested += 1
+                pending = out[1:]
+            else:
+                last_done, last_t, last_decided, last_breach = harvest(
+                    out[1:5]
+                )
+                last_dev = out[5:]
+                harvested += 1
+            if harvested >= n_disp_admit and last_done.all():
+                break
+            if d >= disp_cap:
+                break
+        if pending is not None:
+            last_done, last_t, last_decided, last_breach = harvest(
+                pending[:4]
+            )
+            last_dev = pending[4:]
+            harvested += 1
+    wall = time.perf_counter() - t0  # paxlint: allow[DET001] wall metric only; never reaches artifacts
+
+    summaries, windows, region_windows = last_dev
+    # Host-confirmed verdicts for the flagged lanes ONLY — the named
+    # (lane, region) breach windows; everything else never transfers.
+    slo_dict = None
+    if slo is not None:
+        slo_dict = {}
+        for i in np.flatnonzero(last_breach):
+            i = int(i)
+            hist = np.asarray(windows.lat_hist[i])  # paxlint: allow[JAX103] post-clock confirm: ONLY flagged lanes transfer, one slice each — the monitor's whole point
+            slo_dict[i] = sh.slo_windows(
+                {"window_rounds": ww, "lat_hist": hist},
+                slo,
+                region_series=np.asarray(region_windows[i]),
+                region_names=region_names,
+            )
+    return ServeFleetReport(
+        cfg=cfg,
+        n_lanes=n_lanes,
+        seeds=[ln.seed for ln in lanes],
+        rounds_per_window=int(rounds_per_window),
+        windows_per_dispatch=s,
+        admit_width=k,
+        window_rounds=ww,
+        dispatches=d,
+        rounds=int(last_t.max()),
+        done=bool(last_done.all()),
+        n_values=[pl.n_values for pl in plans],
+        decided=last_decided,
+        wall_seconds=wall,
+        breach=last_breach,
+        first_breach_dispatch=first_breach,
+        slo=slo_dict,
+        region_names=tuple(region_names),
+        final=sss,
+        summaries=summaries,
+        windows=windows,
+        region_windows=region_windows,
+    )
+
+
+# ---------------- the (lanes x offered-rates) surface ----------------
+
+
+def _agg_windows_hist(rep: ServeFleetReport) -> tuple[np.ndarray, int]:
+    """Fleet-aggregate windowed latency histogram ``[W, B]`` and the
+    observed latency max — reduced ON DEVICE over the lane axis, so
+    only the small aggregate transfers."""
+    hist = np.asarray(jnp.sum(rep.windows.lat_hist, axis=0))
+    lat_max = int(np.asarray(jnp.max(rep.summaries.lat_max)))
+    return hist, lat_max
+
+
+def _steady_p50_of(hist: np.ndarray, lat_max: int) -> int | None:
+    """Steady-state median over a ``[W, B]`` windowed histogram — the
+    harness's ``_steady_p50`` on an aggregate series (median of the
+    active buckets' bucket-edge medians)."""
+    p50s = [
+        telem.latency_quantile(row, 0.50, lat_max)
+        for row in hist
+    ]
+    p50s = [p for p in p50s if p >= 0]
+    if not p50s:
+        return None
+    return sorted(p50s)[len(p50s) // 2]
+
+
+def _fleet_point(rate_milli: int, rep: ServeFleetReport) -> dict:
+    hist, lat_max = _agg_windows_hist(rep)
+    total = hist.sum(axis=0)
+    steady = _steady_p50_of(hist, lat_max)
+    return {
+        "rate_milli": int(rate_milli),
+        "lanes": rep.n_lanes,
+        "decided": rep.decided_total,
+        "backlog": rep.backlog,
+        "done": rep.done,
+        "rounds": rep.rounds,
+        "dispatches": rep.dispatches,
+        "wall_seconds": round(rep.wall_seconds, 4),
+        "values_per_sec": round(rep.values_per_sec, 1),
+        "sustained": bool(rep.done and rep.backlog == 0),
+        "p50": telem.latency_quantile(total, 0.50, lat_max),
+        "p99": telem.latency_quantile(total, 0.99, lat_max),
+        **({"p50_steady": steady} if steady is not None else {}),
+        "breach_lanes": [int(i) for i in np.flatnonzero(rep.breach)],
+        **({
+            "slo": {str(i): v for i, v in rep.slo.items()}
+        } if rep.slo else {}),
+    }
+
+
+def fleet_lanes(
+    cfg: SimConfig,
+    n_lanes: int,
+    n_values: int,
+    rate_milli: int,
+    seed: int,
+    arrivals: str = "poisson",
+) -> list[ServeLane]:
+    """Build one tenant fleet: ``n_lanes`` independent streams of
+    ``n_values`` values each at offered rate ``rate_milli`` — every
+    lane draws its OWN arrival process (seed-mixed per lane) and its
+    own engine seed, deterministically per (seed, lane)."""
+    build = arrv.ARRIVAL_BUILDERS[arrivals]
+    vids = np.arange(int(n_values), dtype=np.int32)
+    n_prop = len(cfg.proposers)
+    out = []
+    for li in range(int(n_lanes)):
+        rounds = build(n_values, int(rate_milli), seed + 101 * li)
+        streams, arrs = arrv.split_round_robin(vids, rounds, n_prop)
+        out.append(ServeLane(streams, arrs, seed + li))
+    return out
+
+
+def grid_admit_width(
+    cfg: SimConfig,
+    n_values: int,
+    lane_counts,
+    rates_milli,
+    *,
+    seed: int = 0,
+    rounds_per_window: int = sh.ROUNDS_PER_WINDOW,
+    arrivals: str = "poisson",
+) -> int:
+    """ONE admit width covering every (lane count x rate) cell of a
+    sweep grid: the (L, S, K) call shape keys the executable, so the
+    grid must not fork it per rate.  Shared by :func:`sweep_fleet_load`
+    and the bench (which needs the width BEFORE its warm pass)."""
+    width = 1
+    for lc in lane_counts:
+        for rm in rates_milli:
+            for ln in fleet_lanes(cfg, lc, n_values, rm, seed, arrivals):
+                width = max(
+                    width,
+                    arrv.ArrivalPlan(
+                        ln.workload, ln.arrivals, rounds_per_window
+                    ).max_block,
+                )
+    return width
+
+
+def sweep_fleet_load(
+    cfg: SimConfig,
+    n_values: int,
+    lane_counts,
+    rates_milli,
+    *,
+    seed: int = 0,
+    rounds_per_window: int = sh.ROUNDS_PER_WINDOW,
+    windows_per_dispatch: int = sh.WINDOWS_PER_DISPATCH,
+    admit_width: int | None = None,
+    window_rounds: int | None = None,
+    knee_factor: float = 2.0,
+    slo: sh.ServeSLO | None = None,
+    region_map=None,
+    region_names: tuple = (),
+    mesh=None,
+    arrivals: str = "poisson",
+) -> dict:
+    """The headline SURFACE: aggregate sustained values/sec and the
+    saturation knee over (lane count x offered rate).  One cell = one
+    fleet run of ``lane_count`` tenant streams, each ``n_values``
+    values at ``rate_milli``; every cell of a lane count shares the
+    envelope's one cached executable (admit width is the max over the
+    whole grid, so the call shape never varies within a lane count),
+    and the knee per lane count is ``harness.judge_knee`` over that
+    row — a knee SURFACE, not a knee point."""
+    lane_counts = [int(x) for x in lane_counts]
+    rates = sorted(int(x) for x in rates_milli)
+    # an explicit admit_width is AUTHORITATIVE (the caller computed it
+    # via grid_admit_width and may have warmed executables at exactly
+    # that shape — recomputing here would duplicate the whole grid's
+    # plan construction); a too-narrow width fails loudly per run
+    width = (
+        int(admit_width) if admit_width
+        else grid_admit_width(
+            cfg, n_values, lane_counts, rates, seed=seed,
+            rounds_per_window=rounds_per_window, arrivals=arrivals,
+        )
+    )
+    cells = {}
+    knee_surface = []
+    surface = {}
+    for lc in lane_counts:
+        points = []
+        for rm in rates:
+            rep = serve_fleet_run(
+                cfg,
+                fleet_lanes(cfg, lc, n_values, rm, seed, arrivals),
+                rounds_per_window=rounds_per_window,
+                windows_per_dispatch=windows_per_dispatch,
+                admit_width=width,
+                window_rounds=window_rounds,
+                slo=slo,
+                region_map=region_map,
+                region_names=region_names,
+                mesh=mesh,
+            )
+            points.append(_fleet_point(rm, rep))
+        knee = sh.judge_knee(points, knee_factor)
+        cells[str(lc)] = {"points": points, "knee": knee}
+        knee_surface.append({"lanes": lc, **knee})
+        surface[str(lc)] = {
+            str(pt["rate_milli"]): pt["values_per_sec"] for pt in points
+        }
+    return {
+        "metric": "serve_fleet_latency_at_load_surface",
+        "n_values": int(n_values),
+        "arrivals": arrivals,
+        "rounds_per_window": int(rounds_per_window),
+        "windows_per_dispatch": int(windows_per_dispatch),
+        "admit_width": width,
+        "lane_counts": lane_counts,
+        "rates_milli": rates,
+        "values_per_sec_surface": surface,
+        "cells": cells,
+        "knee_surface": knee_surface,
+    }
+
+
+# ---------------- CLI ----------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_paxos serve --fleet",
+        description="fleet serving: many tenant streams per dispatch "
+        "(vmapped serve windows, donated stacked loop state, on-device "
+        "per-lane SLO verdicts); single-cell run or the (lanes x "
+        "rates) sustained-load + knee surface",
+    )
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--proposers", type=int, default=2)
+    ap.add_argument("--lanes", type=int, default=4,
+                    help="tenant streams per dispatch")
+    ap.add_argument("--lane-counts", type=str, default="",
+                    help="comma-separated lane counts: sweep the "
+                    "(lanes x rates) SURFACE instead of one cell")
+    ap.add_argument("--values", type=int, default=128,
+                    help="values per lane stream")
+    ap.add_argument("--rate-milli", type=int, default=4000)
+    ap.add_argument("--sweep", type=str, default="",
+                    help="comma-separated rate_milli list (the "
+                    "surface's rate axis; single-cell otherwise)")
+    ap.add_argument("--arrivals", type=str, default="poisson",
+                    choices=sorted(arrv.ARRIVAL_BUILDERS),
+                    help="arrival process per lane (serve/arrivals.py)")
+    ap.add_argument("--rounds-per-window", type=int,
+                    default=sh.ROUNDS_PER_WINDOW)
+    ap.add_argument("--windows-per-dispatch", type=int,
+                    default=sh.WINDOWS_PER_DISPATCH)
+    ap.add_argument("--window-rounds", type=int, default=-1,
+                    help="windowed bucket width in rounds (-1 = 4 "
+                    "admission windows)")
+    ap.add_argument("--slo-latency", type=int, default=0,
+                    help="latency SLO in rounds; arms the on-device "
+                    "per-lane burn-rate verdict (0 = no SLO)")
+    ap.add_argument("--slo-budget-milli", type=int, default=100)
+    ap.add_argument("--instances", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-rounds", type=int, default=20_000)
+    ap.add_argument("--drop-rate", type=int, default=0)
+    ap.add_argument("--dup-rate", type=int, default=0)
+    ap.add_argument("--max-delay", type=int, default=0)
+    ap.add_argument("--crash-rate", type=int, default=0)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="tile the lane axis over an N-device mesh "
+                    "(shard_map; lanes must tile it)")
+    ap.add_argument("--backend", choices=("tpu", "cpu", "auto"),
+                    default="auto")
+    args = ap.parse_args(argv)
+    from tpu_paxos.__main__ import _select_backend
+
+    _select_backend(args.backend)
+    n_inst = args.instances or max(64, 2 * args.values)
+    cfg = SimConfig(
+        n_nodes=args.nodes,
+        n_instances=n_inst,
+        proposers=tuple(range(args.proposers)),
+        seed=args.seed,
+        max_rounds=args.max_rounds,
+        faults=FaultConfig(
+            drop_rate=args.drop_rate,
+            dup_rate=args.dup_rate,
+            max_delay=args.max_delay,
+            crash_rate=args.crash_rate,
+        ),
+    )
+    mesh = None
+    if args.mesh > 1:
+        from tpu_paxos.parallel import mesh as pmesh
+
+        mesh = pmesh.make_instance_mesh(args.mesh)
+    w_rounds = None if args.window_rounds < 0 else args.window_rounds
+    slo = (
+        sh.ServeSLO(latency_rounds=args.slo_latency,
+                    budget_milli=args.slo_budget_milli)
+        if args.slo_latency else None
+    )
+    if args.sweep or args.lane_counts:
+        rates = (
+            [int(x) for x in args.sweep.split(",") if x.strip()]
+            if args.sweep else [args.rate_milli]
+        )
+        lane_counts = (
+            [int(x) for x in args.lane_counts.split(",") if x.strip()]
+            if args.lane_counts else [args.lanes]
+        )
+        summary = sweep_fleet_load(
+            cfg, args.values, lane_counts, rates,
+            seed=args.seed,
+            rounds_per_window=args.rounds_per_window,
+            windows_per_dispatch=args.windows_per_dispatch,
+            window_rounds=w_rounds,
+            slo=slo,
+            mesh=mesh,
+            arrivals=args.arrivals,
+        )
+        # every lane count's LOWEST-rate cell must drain (a fleet
+        # that saturates even at the floor rate is broken regardless
+        # of how the single-lane row looks); breaches confirmed by
+        # the host judge red the sweep too
+        summary["ok"] = bool(
+            all(
+                c["points"][0]["sustained"]
+                for c in summary["cells"].values()
+            )
+            and all(
+                not pt.get("slo")
+                or all(v["ok"] for v in pt["slo"].values())
+                for c in summary["cells"].values() for pt in c["points"]
+            )
+        )
+    else:
+        rep = serve_fleet_run(
+            cfg,
+            fleet_lanes(cfg, args.lanes, args.values, args.rate_milli,
+                        args.seed, args.arrivals),
+            rounds_per_window=args.rounds_per_window,
+            windows_per_dispatch=args.windows_per_dispatch,
+            window_rounds=w_rounds,
+            slo=slo,
+            mesh=mesh,
+        )
+        summary = {
+            "metric": "serve_fleet",
+            "arrivals": args.arrivals,
+            **_fleet_point(args.rate_milli, rep),
+            "first_breach_dispatch": [
+                fb for fb in rep.first_breach_dispatch
+            ],
+            "ok": bool(
+                rep.done and rep.backlog == 0
+                and (not rep.slo
+                     or all(v["ok"] for v in rep.slo.values()))
+            ),
+        }
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if summary["ok"] else 1
+
+
+# ---------------- IR-audit registration (analysis/jaxpr_audit) ------
+
+
+def audit_entries():
+    """Canonical fleet serve-window trace (analysis/registry.py): 2
+    lanes of the audit config geometry with i.i.d. faults on, a
+    2-sub-window dispatch of real admission blocks through the
+    vmapped stamp + append + recorder-armed round spans, the
+    on-device per-lane summary/window/region epilogues, and the
+    runtime-threshold SLO breach reduction.  ``donate_argnums=(0,)``
+    arms the HLO tier's aliasing checker on every leaf of the
+    ``[lanes]``-stacked loop state (``hlo_build`` lowers through the
+    product jit itself)."""
+    from tpu_paxos.analysis.registry import AuditEntry
+    from tpu_paxos.core.sim import audit_canonical_cfg
+
+    r_window, s_windows, k_admit, n_lanes = 8, 2, 4, 2
+    w_rounds = r_window * 4
+
+    def _setup():
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
+        )
+        workload = simm.default_workload(cfg)
+        v_bound = drv.vid_bound_of(workload)
+        _, _, _, c = simm.prepare_queues(cfg, workload)
+        runner = ServeFleetRunner(
+            cfg, c, v_bound, r_window, w_rounds
+        )
+        p = len(cfg.proposers)
+        width = c + cfg.assign_window
+        pend = np.full((n_lanes, p, width), int(val.NONE), np.int32)
+        gate = np.full((n_lanes, p, width), int(val.NONE), np.int32)
+        tail = np.zeros((n_lanes, p), np.int32)
+        roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
+        sss = runner._init(
+            jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), roots
+        )
+        admits = np.full(
+            (n_lanes, s_windows, p, k_admit), int(val.NONE), np.int32
+        )
+        arrs = np.zeros((n_lanes, s_windows, p, k_admit), np.int32)
+        for pi, w in enumerate(workload):
+            w = np.asarray(w, np.int32)
+            for si in range(s_windows):
+                blk = w[si * k_admit:(si + 1) * k_admit]
+                admits[:, si, pi, :len(blk)] = blk
+                arrs[:, si, pi, :len(blk)] = si * r_window
+        vid_regions = np.zeros((n_lanes, v_bound), np.int32)
+        rmaps = np.zeros((n_lanes, cfg.n_nodes), np.int32)
+        slo_args = tuple(
+            jnp.asarray(x)
+            for x in _slo_args(
+                sh.ServeSLO(latency_rounds=16, budget_milli=100,
+                            regions=(("us", 8),)),
+                ("us",),
+            )
+        )
+        args = (
+            sss, roots, jnp.asarray(admits), jnp.asarray(arrs),
+            jnp.asarray(vid_regions), jnp.asarray(rmaps), *slo_args,
+        )
+        return runner._fn, args
+
+    def build():
+        return _setup()
+
+    def hlo_build():
+        fn, args = _setup()
+        return fn, args, {}
+
+    ir204_why = (
+        "the vmapped window body IS core/sim's round_fn — same "
+        "unique-key compaction sorts as sim.run_rounds"
+    )
+    return [
+        AuditEntry(
+            "serve.fleet_window", build,
+            covers=("ServeFleetRunner.__init__",),
+            allow=("IR204",), why=ir204_why,
+            donate_argnums=(0,),
+            hlo_build=hlo_build,
+            hlo_golden=True,
+        ),
+    ]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

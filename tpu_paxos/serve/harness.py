@@ -92,17 +92,18 @@ class ServeSLO:
     topology preset's region names (``core/wan.py`` — use
     :func:`region_slo` to build one from a preset).  Each region's
     threshold is judged as its own SLO with breach windows NAMED per
-    region in the verdict's ``regions`` block — but, today, every
-    region judges the GLOBAL windowed latency series: the recorder
-    carries one cluster-wide histogram, so a region's verdict means
-    "the cluster met this region's declared budget", not "this
-    region's own decisions did".  Per-region latency SERIES (so a
-    slow far region cannot red-flag a fast near one) arrive with
-    item 2's per-lane serve fleet — this field is that hook's
-    declaration surface, shipped now so WAN presets, dashboards, and
-    sweeps carry named region budgets end to end.  The global
-    ``latency_rounds`` stays the cluster-wide floor judgment; the
-    report's ``ok`` requires the global AND every region to hold."""
+    region in the verdict's ``regions`` block — against the region's
+    OWN windowed latency series whenever one is available (a run with
+    a declared ``region_map``: ``serve_run`` recomputes the per-region
+    series post-clock from its own ingest table, and fleet serve
+    lanes reduce them ON DEVICE — ``serve/fleet.py``, breach windows
+    named per (lane, region)), so a slow far region can no longer
+    red-flag a fast near one.  A region with no series (no region map
+    declared) falls back to judging the GLOBAL series against its
+    budget — the pre-fleet behavior, marked ``"series": "global"`` in
+    the verdict.  The global ``latency_rounds`` stays the
+    cluster-wide floor judgment; the report's ``ok`` requires the
+    global AND every region to hold."""
 
     latency_rounds: int
     budget_milli: int = 100
@@ -195,7 +196,12 @@ def _judge_series(
     }
 
 
-def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
+def slo_windows(
+    windows_dict: dict,
+    slo: ServeSLO,
+    region_series=None,
+    region_names: tuple = (),
+) -> dict:
     """Judge one run's windowed latency series against ``slo``:
     per-window totals/bad-counts/burn rates, the named breach
     windows (with their round spans), and the run-total verdict the
@@ -207,19 +213,34 @@ def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
     With per-region budgets declared (``slo.regions``), each region's
     latency threshold is judged as its own SLO and named in the
     ``regions`` block (``regions_ok`` aggregates them); the top-level
-    ``ok`` then requires the global verdict AND every region's."""
+    ``ok`` then requires the global verdict AND every region's.
+    ``region_series`` (``[R, W, B]`` per-region windowed histograms,
+    ``telemetry/recorder.region_window_hist``) with ``region_names``
+    (index order) routes each named region to its OWN series —
+    ``"series": "region"`` in its verdict; regions without one fall
+    back to the global series (``"series": "global"``), the
+    pre-fleet behavior."""
     hist = np.asarray(windows_dict["lat_hist"], np.int64)  # [W, B]
     wr = int(windows_dict["window_rounds"])
     out = _judge_series(
         hist, wr, slo.latency_rounds, slo.budget_milli, slo.burn_breach
     )
     if slo.regions:
-        region_verdicts = {
-            name: _judge_series(
-                hist, wr, lat, slo.budget_milli, slo.burn_breach
+        names = tuple(region_names)
+        region_verdicts = {}
+        for name, lat in slo.regions:
+            if region_series is not None and name in names:
+                series = np.asarray(
+                    region_series, np.int64
+                )[names.index(name)]
+                which = "region"
+            else:
+                series, which = hist, "global"
+            v = _judge_series(
+                series, wr, lat, slo.budget_milli, slo.burn_breach
             )
-            for name, lat in slo.regions
-        }
+            v["series"] = which
+            region_verdicts[name] = v
         regions_ok = all(v["ok"] for v in region_verdicts.values())
         out["regions"] = {
             name: {
@@ -227,6 +248,7 @@ def slo_windows(windows_dict: dict, slo: ServeSLO) -> dict:
                     "latency_rounds", "latency_rounds_effective",
                     "burn", "burn_max", "breach_windows",
                     "breach_spans", "ok", "total_bad_milli", "total_ok",
+                    "series",
                 )
             }
             for name, v in region_verdicts.items()
@@ -270,6 +292,12 @@ class ServeReport:
     windows: dict | None = None
     #: SLO verdict (slo_windows) — None unless an SLO was declared
     slo: dict | None = None
+    #: per-region windowed latency histograms ``[R, W, B]`` (the
+    #: host-recomputed twin of the fleet lanes' on-device series,
+    #: recorder.region_window_hist_host) — None unless a region map
+    #: was declared; regions named by ``region_names``
+    region_windows: np.ndarray | None = None
+    region_names: tuple = ()
     #: first dispatch (1-based) whose harvested windowed series
     #: already named a breach window — the burn-rate monitor's
     #: per-dispatch output; None = never breached (or no SLO)
@@ -291,6 +319,8 @@ def serve_run(
     pipelined: bool = True,
     window_rounds: int | None = None,
     slo: ServeSLO | None = None,
+    region_map=None,
+    region_names: tuple = (),
 ) -> ServeReport:
     """Serve one value stream open-loop to completion (or the round
     budget).  ``workload[p]`` is proposer ``p``'s vid sequence in
@@ -298,6 +328,13 @@ def serve_run(
     (nondecreasing — the queue is FIFO per proposer).  All values
     arriving at round 0 is the zero-load parity shape: the run is
     decision-log-identical to closed-loop ``sim.run(cfg, workload)``.
+
+    ``region_map`` (``[A]`` int32 node->region, e.g. a WAN preset's
+    ``wan.node_regions``) with ``region_names`` adds PER-REGION
+    windowed latency series to the report — recomputed post-clock on
+    the host from the harness's own ingest table (zero change to the
+    compiled window; the fleet path reduces the same series on
+    device) — and routes each declared region SLO to its own series.
 
     ``admit_width`` pins the upload block's static width and
     ``windows_per_dispatch`` the amortization depth (one executable
@@ -441,8 +478,27 @@ def serve_run(
     lat_max = int(host_summ.lat_max)
     decided_values = int(hist.sum())
     windows_dict = sd.get("windows")
+    region_hists = None
+    if region_map is not None and ww:
+        # post-clock host twin of the fleet lanes' on-device series:
+        # the ingest table is the harness's OWN data (every value's
+        # true arrival round), the decision arrays transfer after the
+        # clock stopped anyway — no compiled-program change
+        rmap = np.asarray(region_map, np.int32).reshape(cfg.n_nodes)
+        ingest_host = np.full((v_bound,), int(val.NONE), np.int32)
+        vid_region = np.zeros((v_bound,), np.int32)
+        for node, s_p, a_p in zip(cfg.proposers, plan.streams, plan.arrs):
+            ingest_host[s_p] = a_p
+            vid_region[s_p] = rmap[node]
+        region_hists = telem.region_window_hist_host(
+            ingest_host,
+            np.asarray(ss.sim.met.chosen_vid),
+            np.asarray(ss.sim.met.chosen_round),
+            vid_region, ww,
+        )
     slo_dict = (
-        slo_windows(windows_dict, slo)
+        slo_windows(windows_dict, slo, region_series=region_hists,
+                    region_names=region_names)
         if slo is not None and windows_dict is not None else None
     )
     return ServeReport(
@@ -473,6 +529,8 @@ def serve_run(
         slo_first_breach_dispatch=(
             first_breach[0] if first_breach else None
         ),
+        region_windows=region_hists,
+        region_names=tuple(region_names),
     )
 
 
@@ -682,6 +740,11 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", type=str, default="",
                     help="JSON file with an explicit arrival-round "
                     "list (trace replay; overrides --rate-milli)")
+    ap.add_argument("--arrivals", type=str, default="poisson",
+                    choices=sorted(arrv.ARRIVAL_BUILDERS),
+                    help="arrival process at --rate-milli: poisson, "
+                    "heavy-tailed pareto, bursty, or diurnal "
+                    "(serve/arrivals.py; deterministic per seed)")
     ap.add_argument("--rounds-per-window", type=int,
                     default=ROUNDS_PER_WINDOW)
     ap.add_argument("--windows-per-dispatch", type=int,
@@ -756,7 +819,7 @@ def main(argv=None) -> int:
         elif args.rate_milli <= 0:
             rounds = arrv.immediate_rounds(args.values)
         else:
-            rounds = arrv.poisson_rounds(
+            rounds = arrv.ARRIVAL_BUILDERS[args.arrivals](
                 args.values, args.rate_milli, args.seed
             )
         streams, arrs = arrv.split_round_robin(

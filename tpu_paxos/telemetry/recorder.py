@@ -320,6 +320,78 @@ def serve_admit_rounds(ingest, chosen_vid):
     return jnp.where(ok, adm, val.NONE)
 
 
+def region_window_hist(
+    admit_round, chosen_vid, chosen_round, vid_region, window_rounds: int
+):
+    """Per-REGION windowed commit-latency histograms, on device:
+    ``[NUM_REGIONS, NUM_WINDOWS, NUM_LAT_BUCKETS]`` int32 — the
+    windowed series split by the region of each decided value's OWNER
+    (``vid_region``: ``[V]`` int32, the region of the proposer that
+    serves vid ``v``, clamped into the region bound).  Exactly the
+    :func:`summarize_windows` latency bucketing (decision round picks
+    the window, ingest-stamped latency picks the bucket), so summing
+    over the region axis recovers the global windowed histogram
+    bucket-for-bucket — the per-region series are a PARTITION of the
+    global one, and a region's SLO can be judged on its own traffic
+    (serve/harness.ServeSLO.regions) instead of the cluster-wide
+    series.  No-op fills and out-of-table vids are excluded like
+    everywhere else (their admission stamp is NONE)."""
+    import jax.numpy as jnp
+
+    decided_mask = chosen_vid != val.NONE  # [I]
+    lat_ok = decided_mask & (admit_round != val.NONE)
+    lat = jnp.where(lat_ok, jnp.maximum(chosen_round - admit_round, 0), 0)
+    wb = window_bucket(jnp.where(decided_mask, chosen_round, 0),
+                       window_rounds)  # [I]
+    edges = jnp.asarray(LAT_EDGES, jnp.int32)
+    lb = jnp.sum(lat[:, None] > edges[None, :], axis=1)  # [I]
+    v = vid_region.shape[0]
+    reg_tab = jnp.clip(
+        jnp.asarray(vid_region, jnp.int32), 0, NUM_REGIONS - 1
+    )  # [V]
+    in_tab = (chosen_vid >= 0) & (chosen_vid < v)
+    reg = jnp.where(
+        in_tab, reg_tab[jnp.clip(chosen_vid, 0, v - 1)], 0
+    )  # [I]
+    return jnp.zeros(
+        (NUM_REGIONS, NUM_WINDOWS, NUM_LAT_BUCKETS), jnp.int32
+    ).at[reg, wb, lb].add((lat_ok & in_tab).astype(jnp.int32))
+
+
+def region_window_hist_host(
+    ingest, chosen_vid, chosen_round, vid_region, window_rounds: int
+) -> np.ndarray:
+    """Post-clock host twin of :func:`region_window_hist` for the
+    single-stream serve harness: the same per-region windowed latency
+    histograms recomputed in numpy from the harness's own ingest
+    table (``[V]`` arrival round per vid) and the final decision
+    arrays — zero change to the compiled serve window, because the
+    arrays it needs already transfer after the clock stops.  Pinned
+    equal to the on-device fleet-lane version by
+    tests/test_serve_fleet.py (single-lane parity)."""
+    ingest = np.asarray(ingest, np.int64)
+    chosen_vid = np.asarray(chosen_vid, np.int64)
+    chosen_round = np.asarray(chosen_round, np.int64)
+    vid_region = np.asarray(vid_region, np.int64)
+    v = len(ingest)
+    in_tab = (chosen_vid >= 0) & (chosen_vid < v)
+    adm = np.where(in_tab, ingest[np.clip(chosen_vid, 0, v - 1)],
+                   int(val.NONE))
+    lat_ok = in_tab & (adm != int(val.NONE))
+    lat = np.where(lat_ok, np.maximum(chosen_round - adm, 0), 0)
+    wb = np.minimum(
+        np.where(lat_ok, chosen_round, 0) // int(window_rounds),
+        NUM_WINDOWS - 1,
+    )
+    edges = np.asarray(LAT_EDGES, np.int64)
+    lb = (lat[:, None] > edges[None, :]).sum(axis=1)
+    reg_tab = np.clip(vid_region, 0, NUM_REGIONS - 1)
+    reg = np.where(in_tab, reg_tab[np.clip(chosen_vid, 0, v - 1)], 0)
+    hist = np.zeros((NUM_REGIONS, NUM_WINDOWS, NUM_LAT_BUCKETS), np.int32)
+    np.add.at(hist, (reg[lat_ok], wb[lat_ok], lb[lat_ok]), 1)
+    return hist
+
+
 def region_reduce(edge_counts, region_map):
     """Reduce one ``[A, A]`` per-edge counter to fixed-shape
     ``[NUM_REGIONS, NUM_REGIONS]`` per-region-pair totals via the
