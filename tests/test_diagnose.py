@@ -1,0 +1,368 @@
+"""Breach attribution (telemetry/diagnose.py): TP/TN fixtures per
+cause on crafted windowed series, the ambiguous gray+saturation
+window (both candidates ranked, never silently one), determinism
+(byte-identical output for identical input), and the seeded-cause
+recall pins — a wan-3region gray schedule classifies ``gray-region``,
+an over-knee serve rate classifies ``saturation``, a region-pair cut
+schedule classifies ``partition`` (slow tier; its fast coverage is
+the crafted partition fixture here plus the gray/saturation engine
+runs, which exercise the same harvested-series plumbing)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from tpu_paxos.telemetry import diagnose as diag
+from tpu_paxos.telemetry import recorder as telem
+
+W = telem.NUM_WINDOWS
+B = telem.NUM_LAT_BUCKETS
+NP_ = telem.NUM_PHASES
+A = 3
+
+
+def _mk_dict(**over):
+    """A quiet, healthy windowed dict (4 active windows of modest
+    traffic) the fixtures perturb per cause."""
+    d = {
+        "window_rounds": 16,
+        "n_windows": W,
+        "decided": [8] * 4 + [0] * (W - 4),
+        "offered": [100] * 4 + [0] * (W - 4),
+        "dropped": [1] * 4 + [0] * (W - 4),
+        "drop_rate_observed": [100.0] * 4 + [0.0] * (W - 4),
+        "stall_max": [0] * W,
+        "takeovers": [0] * W,
+        "restarts": [0] * W,
+        "cut": [0] * W,
+        "backlog_max": [1] * 4 + [0] * (W - 4),
+        "node_offered": [[30] * A] * 4 + [[0] * A] * (W - 4),
+        "node_delay": [[15] * A] * 4 + [[0] * A] * (W - 4),
+        "latency_p50": [2] * 4 + [-1] * (W - 4),
+        "phase_hist": np.zeros((W, NP_, B), np.int64),
+        "lat_hist": np.zeros((W, B), np.int64).tolist(),
+    }
+    ph = np.asarray(d["phase_hist"])
+    ph[:4, telem.PHASE_CONSENSUS, 1] = 8  # modest consensus latency
+    d["phase_hist"] = ph.tolist()
+    d.update(over)
+    return d
+
+
+def _set_phase(d, w, phase, bucket, n):
+    ph = np.asarray(d["phase_hist"])
+    ph[w, phase, bucket] = n
+    d["phase_hist"] = ph.tolist()
+
+
+# ---------------- per-cause TP/TN fixtures ----------------
+
+
+def test_saturation_tp_and_tn():
+    d = _mk_dict()
+    d["backlog_max"][2] = 20  # growth vs baseline 1
+    _set_phase(d, 2, telem.PHASE_QUEUE, 6, 8)  # queue-wait dominates
+    v = diag.diagnose_window(d, 2)
+    assert v["cause"] == "saturation"
+    ev = v["candidates"][0]["evidence"]
+    assert ev["backlog"] == 20 and ev["dominant_phase"] == "queue"
+    assert ev["drops_nominal"] is True
+    # TN: same phase shape but the backlog stays flat — a slow
+    # consensus is not saturation
+    d2 = _mk_dict()
+    _set_phase(d2, 2, telem.PHASE_QUEUE, 6, 8)
+    assert diag.diagnose_window(d2, 2)["cause"] == "unknown"
+    # TN: backlog grows but latency is consensus-dominated (a duel,
+    # not an overload)
+    d3 = _mk_dict()
+    d3["backlog_max"][2] = 20
+    _set_phase(d3, 2, telem.PHASE_CONSENSUS, 7, 20)
+    assert "saturation" not in [
+        c["cause"] for c in diag.diagnose_window(d3, 2)["candidates"]
+    ]
+
+
+def test_gray_region_tp_named_and_tn():
+    d = _mk_dict()
+    # node 2's per-copy mean delay triples; others stay at rest
+    nd = np.asarray(d["node_delay"])
+    nd[2, 2] = 90  # 90/30 copies = 3000 milli vs baseline 500
+    d["node_delay"] = nd.tolist()
+    rmap = [0, 1, 2]
+    v = diag.diagnose_window(
+        d, 2, region_map=rmap, region_names=("us", "eu", "ap")
+    )
+    assert v["cause"] == "gray-region"
+    ev = v["candidates"][0]["evidence"]
+    assert ev["nodes"] == [2] and ev["regions"] == ["ap"]
+    assert ev["backlog_flat"] is True
+    # without a region map the NODE is still named
+    v2 = diag.diagnose_window(d, 2)
+    assert v2["cause"] == "gray-region"
+    assert "regions" not in v2["candidates"][0]["evidence"]
+    # TN: the same inflation with severed-edge losses in the window
+    # is never gray (a gray node slows, it does not sever — and the
+    # cut's traffic-mix shift fakes inflation)
+    d_cut = json.loads(json.dumps(d))
+    d_cut["cut"][2] = 5
+    causes = [
+        c["cause"] for c in diag.diagnose_window(d_cut, 2)["candidates"]
+    ]
+    assert "gray-region" not in causes
+    # TN: inflation with a drop spike is a sick link, not gray
+    d_drop = json.loads(json.dumps(d))
+    d_drop["drop_rate_observed"][2] = 2000.0
+    causes = [
+        c["cause"]
+        for c in diag.diagnose_window(d_drop, 2)["candidates"]
+    ]
+    assert "gray-region" not in causes
+
+
+def test_gray_attribution_excludes_coinflated_neighbors():
+    """Delays charge both edge endpoints, so a gray node's neighbor
+    co-inflates by its traffic share — only the node(s) near the max
+    inflation delta are named."""
+    d = _mk_dict()
+    nd = np.asarray(d["node_delay"])
+    nd[2, 2] = 90  # node 2: 3000 milli (delta 2500)
+    nd[2, 0] = 36  # node 0: 1200 milli (delta 700 — its share of 2's
+    d["node_delay"] = nd.tolist()  # inflated edges, not its own outage)
+    v = diag.diagnose_window(d, 2)
+    assert v["cause"] == "gray-region"
+    assert v["candidates"][0]["evidence"]["nodes"] == [2]
+
+
+def test_partition_tp_named_pair_and_tn():
+    d = _mk_dict()
+    d["cut"][1] = 12
+    d["stall_max"][1] = 3
+    pairs = {
+        "n_regions": 3,
+        "offered": [[10] * 3] * 3,
+        "dropped": [[0] * 3] * 3,
+        "drop_rate_observed": [[0.0] * 3] * 3,
+        "cut": [[0, 0, 9], [0, 0, 3], [0, 0, 0]],
+        "names": ["us", "eu", "ap"],
+    }
+    v = diag.diagnose_window(
+        d, 1, region_pairs=pairs, region_names=("us", "eu", "ap")
+    )
+    assert v["cause"] == "partition"
+    ev = v["candidates"][0]["evidence"]
+    assert ev["cut_copies"] == 12
+    assert ev["pair"] == "us->ap" and ev["pair_cut_total"] == 9
+    # TN: no severed copies, no partition
+    assert diag.diagnose_window(_mk_dict(), 1)["cause"] == "unknown"
+
+
+def test_duel_churn_tp_and_tn():
+    d = _mk_dict()
+    d["takeovers"][3] = 2
+    d["restarts"][3] = 3
+    _set_phase(d, 3, telem.PHASE_CONSENSUS, 7, 30)  # duels dominate
+    v = diag.diagnose_window(d, 3)
+    assert v["cause"] == "duel-churn"
+    ev = v["candidates"][0]["evidence"]
+    assert ev["takeovers"] == 2 and ev["restarts"] == 3
+    assert ev["dominant_phase"] == "consensus"
+    # TN: one restart is weather, not churn
+    d2 = _mk_dict()
+    d2["restarts"][3] = 1
+    assert diag.diagnose_window(d2, 3)["cause"] == "unknown"
+
+
+def test_ambiguous_gray_plus_saturation_reports_both_ranked():
+    """A window that is BOTH saturating and gray reports both
+    candidates, ranked — never silently one (the controller contract:
+    shed on saturation, never on gray)."""
+    d = _mk_dict()
+    d["backlog_max"][2] = 20
+    _set_phase(d, 2, telem.PHASE_QUEUE, 6, 8)
+    nd = np.asarray(d["node_delay"])
+    nd[2, 2] = 90
+    d["node_delay"] = nd.tolist()
+    v = diag.diagnose_window(d, 2)
+    causes = [c["cause"] for c in v["candidates"]]
+    assert "saturation" in causes and "gray-region" in causes
+    # ranking is deterministic: saturation carries the drops-nominal
+    # support point, gray loses its backlog-flat point to the growth
+    assert causes[0] == "saturation"
+    scores = [c["score"] for c in v["candidates"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+# ---------------- reducers / report plumbing ----------------
+
+
+def test_diagnose_breaches_and_attach():
+    d = _mk_dict()
+    d["backlog_max"][2] = 20
+    _set_phase(d, 2, telem.PHASE_QUEUE, 6, 8)
+    rep = diag.diagnose_breaches(d, [2, 3])
+    assert [v["window"] for v in rep["windows"]] == [2, 3]
+    assert rep["windows"][0]["cause"] == "saturation"
+    assert rep["causes"] == sorted(rep["causes"])
+    # attach: union of global + region breach windows, additive
+    verdict = {
+        "breach_windows": [2],
+        "regions": {"ap": {"breach_windows": [3]}},
+    }
+    out = diag.attach_diagnosis(verdict, d)
+    assert [v["window"] for v in out["diagnosis"]["windows"]] == [2, 3]
+    assert "diagnosis" not in diag.attach_diagnosis(
+        {"breach_windows": []}, d
+    )
+
+
+def test_label_windows_and_series():
+    d = _mk_dict()
+    d["cut"][1] = 12
+    labels = diag.label_windows(d)
+    assert labels[1] == "partition"
+    assert labels[0] is None  # healthy active window
+    assert labels[8] is None  # quiet window
+    rep = diag.diagnose_series(d)
+    assert [v["window"] for v in rep["windows"]] == [1]
+    assert rep["causes"] == ["partition"]
+
+
+def test_determinism_byte_identical():
+    d = _mk_dict()
+    d["backlog_max"][2] = 20
+    _set_phase(d, 2, telem.PHASE_QUEUE, 6, 8)
+    a = diag.diagnose_breaches(d, [2])
+    b = diag.diagnose_breaches(json.loads(json.dumps(d)), [2])
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert diag.fingerprint(a) == diag.fingerprint(b)
+
+
+def test_region_pair_names():
+    assert telem.region_pair_name(("us", "eu", "ap"), 0, 2) == "us->ap"
+    assert telem.region_pair_name((), 1, 2) == "r1->r2"
+    assert telem.region_prefix_names(("us",), 3) == ["us", "r1", "r2"]
+
+
+# ---------------- seeded-cause recall (engine runs) ----------------
+
+
+def _wan3_diag(sched, seed=0):
+    """One wan-3region closed-loop run -> its diagnosis series."""
+    from tpu_paxos.config import SimConfig
+    from tpu_paxos.core import sim, wan as wanm
+
+    preset = wanm.WAN3
+    faults = wanm.wan_fault_config(preset, 3, schedule=sched)
+    cfg = SimConfig(
+        n_nodes=3, n_instances=24, proposers=(0, 1), seed=seed,
+        max_rounds=256, faults=faults,
+    )
+    rmap = wanm.node_regions(preset, 3)
+    res, summ, wsum = sim.run_with_telemetry(cfg, region_map=rmap)
+    sd = telem.summary_to_dict(
+        summ, wsum, telem.WINDOW_ROUNDS, region_names=preset.regions
+    )
+    return diag.diagnose_series(
+        sd["windows"], region_map=rmap, region_names=preset.regions,
+        region_pairs=sd["region_pairs"],
+    )
+
+
+def test_seeded_gray_region_recall_and_replay_parity():
+    """A wan-3region schedule graying the lone 'ap' node classifies
+    ``gray-region`` NAMING ap, and the verdict is byte-identical
+    across two replays (the determinism acceptance pin)."""
+    from tpu_paxos.core import faults as flt
+
+    sched = flt.FaultSchedule((flt.gray(32, 96, 2, delay=4),))
+    rep = _wan3_diag(sched)
+    assert "gray-region" in rep["causes"]
+    gray = [v for v in rep["windows"] if v["cause"] == "gray-region"]
+    assert gray, rep
+    ev = gray[0]["candidates"][0]["evidence"]
+    assert ev["regions"] == ["ap"] and ev["nodes"] == [2]
+    # two replays of the same run: byte-identical diagnosis (the
+    # second run hits the jit cache — no second compile)
+    rep2 = _wan3_diag(sched)
+    assert diag.fingerprint(rep) == diag.fingerprint(rep2)
+
+
+@pytest.mark.slow
+def test_seeded_partition_recall():
+    """A region-pair cut schedule classifies ``partition`` with the
+    severed pair named (us->ap).  Slow tier: the schedule is a
+    compile-time constant, so this cell pays its own engine compile;
+    fast coverage is the crafted partition fixture above plus the
+    gray cell's identical harvested-series plumbing."""
+    from tpu_paxos.core import faults as flt
+
+    sched = flt.FaultSchedule((flt.partition(24, 64, (0, 1), (2,)),))
+    rep = _wan3_diag(sched)
+    assert "partition" in rep["causes"]
+    part = [v for v in rep["windows"] if v["cause"] == "partition"]
+    assert part, rep
+    ev = part[0]["candidates"][0]["evidence"]
+    assert ev["pair"] == "us->ap" and ev["cut_copies"] > 0
+
+
+def test_seeded_saturation_recall_over_knee_serve():
+    """An over-knee serve rate breaches its SLO and the breach report
+    names ``saturation`` (queue-wait-dominated, backlog growth) —
+    threaded end-to-end through serve_run's verdict."""
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.serve import arrivals as arrv
+    from tpu_paxos.serve import harness as sh
+
+    cfg = SimConfig(
+        n_nodes=5, n_instances=128, proposers=(0, 1), seed=0,
+        max_rounds=20_000, faults=FaultConfig(),
+    )
+    vids = np.arange(64, dtype=np.int32)
+    rounds = arrv.poisson_rounds(64, 4000, 0)
+    streams, arrs = arrv.split_round_robin(vids, rounds, 2)
+    rep = sh.serve_run(
+        cfg, streams, arrs, slo=sh.ServeSLO(latency_rounds=16)
+    )
+    assert rep.slo is not None and rep.slo["breach_windows"]
+    dg = rep.slo["diagnosis"]
+    assert "saturation" in dg["causes"]
+    top = dg["windows"][0]
+    assert top["cause"] == "saturation"
+    ev = top["candidates"][0]["evidence"]
+    assert ev["dominant_phase"] == "queue" and ev["backlog"] >= 4
+    # the sweep summary carries the causes per rate (the BENCH block)
+    assert rep.slo["diagnosis"]["windows"][0]["span"][0] == 0
+
+
+def test_phase_hist_closed_loop_invariants():
+    """The phase decomposition's pinned closed-loop identities: the
+    consensus row equals lat_hist bucket-for-bucket (admission IS the
+    first batch), the queue row is all zero-duration, and commit /
+    learn rows count only instances whose ladder/quorum completed."""
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.core import sim
+
+    cfg = SimConfig(
+        n_nodes=3, n_instances=16, proposers=(0, 1), seed=0,
+        max_rounds=64, faults=FaultConfig(drop_rate=500),
+    )
+    res, summ, wsum = sim.run_with_telemetry(cfg)
+    ph = np.asarray(wsum.phase_hist)
+    lat = np.asarray(wsum.lat_hist)
+    assert (ph[:, telem.PHASE_CONSENSUS, :] == lat).all()
+    assert ph[:, telem.PHASE_QUEUE, 1:].sum() == 0
+    assert ph[:, telem.PHASE_QUEUE, 0].sum() == lat.sum()
+    assert ph[:, telem.PHASE_LEARN].sum() <= lat.sum()
+    assert ph[:, telem.PHASE_COMMIT].sum() <= lat.sum()
+    # the ledger stamps come back ordered: batch <= chosen <=
+    # learned/committed wherever both exist
+    res2, s2, w2, led = sim.run_with_telemetry(cfg, return_ledger=True)
+    chosen = res2.chosen_round
+    for k in ("learned_round", "committed_round"):
+        stamp = led[k]
+        ok = (stamp >= 0) & (chosen >= 0)
+        assert (stamp[ok] >= chosen[ok]).all()
+    ok = (led["batch_round"] >= 0) & (chosen >= 0)
+    assert (led["batch_round"][ok] <= chosen[ok]).all()

@@ -111,6 +111,9 @@ def _mk_summary(**over):
         region_dropped=np.zeros(
             (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
         ),
+        region_cut=np.zeros(
+            (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
+        ),
     )
     base.update(over)
     return telem.TelemetrySummary(**base)
@@ -437,3 +440,49 @@ def test_trace_cli_golden():
         "deliberate, re-pin with tests/data/gen_telemetry_goldens.py"
     )
     assert open(os.path.join(REPO, WEDGE_ARTIFACT), "rb").read() == before
+
+
+def test_trace_serve_mode():
+    """``python -m tpu_paxos trace --serve`` (PR 15): a fresh
+    open-loop serve run rendered in-process — windowed counter
+    tracks, the flow-linked per-instance phase spans on the
+    ``phases`` process, and the diagnosis block in otherData.  The
+    flow cap drops deterministically (first N by decision round) and
+    is announced in otherData, never silently."""
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = texport.main([
+            "--serve", "--values", "24", "--rate-milli", "16000",
+            "--nodes", "3", "--slo-latency", "64",
+            "--max-flow-instances", "8", "--stdout", "--json",
+        ])
+    assert rc == 0
+    # --stdout prints the trace; --json appends the status line
+    out = buf.getvalue()
+    trace = json.loads(out[:out.rindex("\n{") + 1] if "\n{" in out
+                       else out)
+    other = trace["otherData"]
+    assert other["engine"] == "serve" and other["decided"] == 24
+    assert other["flow_instances"] == 8
+    assert other["flow_instances_dropped"] == 24 - 8
+    assert "diagnosis" in other and "telemetry" in other
+    evs = trace["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"
+             and e["name"].split(" ")[0] in telem.PHASE_NAMES]
+    assert spans, "no phase spans rendered"
+    assert {e["name"].split(" ")[0] for e in spans} >= {"consensus"}
+    # every sampled instance's spans are flow-linked (s/t/f chain)
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    assert flows and {e["ph"] for e in flows} >= {"s"}
+    # queue-wait spans exist only where ingest-stamped admission
+    # waited; consensus spans cover every sampled instance
+    per_slot = {}
+    for e in spans:
+        per_slot.setdefault(e["tid"], set()).add(
+            e["name"].split(" ")[0]
+        )
+    assert len(per_slot) == 8
+    assert all("consensus" in ph for ph in per_slot.values())

@@ -22,11 +22,16 @@ W = telem.NUM_WINDOWS
 B = telem.NUM_LAT_BUCKETS
 
 
+N_NODES = 3  # crafted-stack node count for the per-node rings
+
+
 def _mk_windows(**over):
     """A host-numpy WindowSummary with recognizable values."""
     lat = np.zeros((W, B), np.int32)
     lat[0, 1] = 4  # bucket (1, 2]
     lat[2, 4] = 6  # bucket (8, 16]
+    phase = np.zeros((W, telem.NUM_PHASES, B), np.int32)
+    phase[:, telem.PHASE_CONSENSUS, :] = lat  # closed-loop shape
     base = dict(
         offered=np.asarray([100] + [10] * (W - 1), np.int32),
         dropped=np.asarray([10] + [1] * (W - 1), np.int32),
@@ -35,8 +40,13 @@ def _mk_windows(**over):
         stall_max=np.asarray([0, 5] + [1] * (W - 2), np.int32),
         takeovers=np.asarray([0, 1] + [0] * (W - 2), np.int32),
         restarts=np.asarray([2] + [0] * (W - 1), np.int32),
+        cut=np.zeros(W, np.int32),
+        backlog_max=np.asarray([3] + [0] * (W - 1), np.int32),
+        node_offered=np.full((W, N_NODES), 10, np.int32),
+        node_delay=np.zeros((W, N_NODES), np.int32),
         decided=lat.sum(axis=1).astype(np.int32),
         lat_hist=lat,
+        phase_hist=phase,
     )
     base.update(over)
     return telem.WindowSummary(**base)
@@ -117,6 +127,9 @@ def test_summary_and_reduce_lanes_windows_integration():
         region_dropped=np.zeros(
             (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
         ),
+        region_cut=np.zeros(
+            (telem.NUM_REGIONS, telem.NUM_REGIONS), np.int32
+        ),
     )
     s = telem.TelemetrySummary(**base)
     assert "windows" not in telem.summary_to_dict(s)
@@ -167,7 +180,7 @@ def test_summarize_windows_run_shorter_than_one_bucket():
     there — no spill, no dilution."""
     import jax.numpy as jnp
 
-    wins = telem.init_windows()
+    wins = telem.init_windows(N_NODES)
     chosen_vid = jnp.asarray([100, 101, -1, 102], jnp.int32)
     chosen_round = jnp.asarray([3, 7, -1, 9], jnp.int32)
     admit = jnp.asarray([1, 1, -1, 2], jnp.int32)
@@ -187,7 +200,7 @@ def test_summarize_windows_boundary_and_overflow():
     enter the series."""
     import jax.numpy as jnp
 
-    wins = telem.init_windows()
+    wins = telem.init_windows(N_NODES)
     hi = 16 * (W + 3)  # far past the grid
     chosen_vid = jnp.asarray([100, 101, 102, -1, -3], jnp.int32)
     chosen_round = jnp.asarray([15, 16, hi, -1, 20], jnp.int32)

@@ -1599,11 +1599,17 @@ def build_engine(
         # pair also feeds the recorder's fault-layer counters
         # (_tsites) — reading values already computed, never sampling.
         edge_pa = (p, a)
-        _tsites = []  # [(alive, delay, post-cut mask, is_pa)] in MSG order
+        # [(alive, delay, post-cut mask, pre-cut mask, is_pa)] in MSG
+        # order: the pre-cut mask exists so the recorder can count
+        # copies lost at SEVERED edges (pre & ~post) — offered stays
+        # post-cut for drop-rate exactness, so partitions would
+        # otherwise be invisible in the fault-layer counters.
+        _tsites = []
         # prepare requests
         al, dl = _plan(keys[0], edge_pa, True)
-        m_prep = _cut_pa(send_prep[:, None] & jnp.ones((p, a), jnp.bool_))
-        _tsites.append((al, dl, m_prep, True))
+        pre_prep = send_prep[:, None] & jnp.ones((p, a), jnp.bool_)
+        m_prep = _cut_pa(pre_prep)
+        _tsites.append((al, dl, m_prep, pre_prep, True))
         net = net._replace(
             prep_req=netm.write_ballot(
                 net.prep_req, t, al, dl, ballot[:, None], m_prep
@@ -1614,7 +1620,7 @@ def build_engine(
         send_rep = grant.T  # [A, P]
         echo_val = preq.T  # [A, P] the granted ballot
         m_rep = _cut_ap(send_rep)
-        _tsites.append((al, dl, m_rep, False))
+        _tsites.append((al, dl, m_rep, send_rep, False))
         net = net._replace(
             prep_echo=netm.write_ballot(
                 net.prep_echo, t, al, dl, echo_val, m_rep
@@ -1624,7 +1630,7 @@ def build_engine(
         al, dl = _plan(keys[2], (a, p), False)
         send_rej = (rej_prep | rej_acc).T
         m_rej = _cut_ap(send_rej)
-        _tsites.append((al, dl, m_rej, False))
+        _tsites.append((al, dl, m_rej, send_rej, False))
         net = net._replace(
             rej=netm.write_ballot(
                 net.rej, t, al, dl,
@@ -1634,8 +1640,9 @@ def build_engine(
         )
         # accepts: per-edge ballot (batch content read at delivery)
         al, dl = _plan(keys[3], edge_pa, True)
-        m_acc = _cut_pa(send_accept[:, None] & jnp.ones((p, a), jnp.bool_))
-        _tsites.append((al, dl, m_acc, True))
+        pre_acc = send_accept[:, None] & jnp.ones((p, a), jnp.bool_)
+        m_acc = _cut_pa(pre_acc)
+        _tsites.append((al, dl, m_acc, pre_acc, True))
         net = net._replace(
             acc_req=netm.write_ballot(
                 net.acc_req, t, al, dl, ballot[:, None], m_acc
@@ -1646,7 +1653,7 @@ def build_engine(
         send_arep = elig.T  # [A, P] reply whenever ballot >= promised
         aecho_val = jnp.broadcast_to(abal[None, :], (a, p))
         m_arep = _cut_ap(send_arep)
-        _tsites.append((al, dl, m_arep, False))
+        _tsites.append((al, dl, m_arep, send_arep, False))
         net = net._replace(
             acc_echo=netm.write_ballot(
                 net.acc_echo, t, al, dl, aecho_val, m_arep
@@ -1655,8 +1662,9 @@ def build_engine(
         # commits: per-edge presence (content read at delivery from
         # the sender's write-once commit_vid)
         al, dl = _plan(keys[5], edge_pa, True)
-        m_com = _cut_pa(send_commit[:, None] & jnp.ones((p, a), jnp.bool_))
-        _tsites.append((al, dl, m_com, True))
+        pre_com = send_commit[:, None] & jnp.ones((p, a), jnp.bool_)
+        m_com = _cut_pa(pre_com)
+        _tsites.append((al, dl, m_com, pre_com, True))
         net = net._replace(
             com_pres=netm.write_flag(net.com_pres, t, al, dl, m_com)
         )
@@ -1664,7 +1672,7 @@ def build_engine(
         al, dl = _plan(keys[6], (a, p), False)
         send_crep = cpres.T  # [A, P]
         m_crep = _cut_ap(send_crep)
-        _tsites.append((al, dl, m_crep, False))
+        _tsites.append((al, dl, m_crep, send_crep, False))
         net = net._replace(
             com_rep=netm.write_flag(net.com_rep, t, al, dl, m_crep)
         )
@@ -1852,34 +1860,67 @@ def build_engine(
         if _ww:
             tele, wins = tele  # windowed builds carry the pair
         tc = [
-            _rec.count_copies(al_, dl_, m_) for (al_, dl_, m_, _pa) in _tsites
+            _rec.count_copies(al_, dl_, m_)
+            for (al_, dl_, m_, _pre, _pa) in _tsites
         ]
-        # Per-edge offered/dropped breakdown (the WAN plane): the
-        # already-computed copy plans and post-cut masks, summed per
-        # direction and scattered into the [A, A] accumulators via
-        # the proposer->node map (pn rows are distinct nodes, so the
-        # two scatters never collide within themselves).
+        # Per-edge offered/dropped/cut/delay breakdown (the WAN
+        # plane): the already-computed copy plans, pre-cut send masks,
+        # and post-cut masks, summed per direction and scattered into
+        # [A, A] round-increment matrices via the proposer->node map
+        # (pn rows are distinct nodes, so the two scatters never
+        # collide within themselves).  ``cut`` counts copies lost at
+        # severed edges (pre & ~post — offered is post-cut by design,
+        # so partitions need their own counter); ``dsum`` sums the
+        # sampled delays of surviving copies (a gray node's inflation
+        # signal, attributable per node below).
         aidx_t = jnp.arange(a)
-        off_pa = drop_pa = jnp.zeros((p, a), jnp.int32)
-        off_ap = drop_ap = jnp.zeros((a, p), jnp.int32)
-        for (al_, _dl_, m_, is_pa) in _tsites:
+        off_pa = drop_pa = cut_pa = dsum_pa = jnp.zeros((p, a), jnp.int32)
+        off_ap = drop_ap = cut_ap = dsum_ap = jnp.zeros((a, p), jnp.int32)
+        for (al_, dl_, m_, pre_, is_pa) in _tsites:
             offc = m_.astype(jnp.int32)
             drpc = (m_ & ~al_[0]).astype(jnp.int32)
+            cutc = (pre_ & ~m_).astype(jnp.int32)
+            dsc = jnp.sum(jnp.where(m_[None] & al_, dl_, 0), axis=0)
             if is_pa:
                 off_pa = off_pa + offc
                 drop_pa = drop_pa + drpc
+                cut_pa = cut_pa + cutc
+                dsum_pa = dsum_pa + dsc
             else:
                 off_ap = off_ap + offc
                 drop_ap = drop_ap + drpc
-        edge_off = tele.edge_offered.at[pn[:, None], aidx_t[None, :]].add(
-            off_pa
-        ).at[aidx_t[:, None], pn[None, :]].add(off_ap)
-        edge_drp = tele.edge_dropped.at[pn[:, None], aidx_t[None, :]].add(
-            drop_pa
-        ).at[aidx_t[:, None], pn[None, :]].add(drop_ap)
+                cut_ap = cut_ap + cutc
+                dsum_ap = dsum_ap + dsc
+
+        def _edge_inc(m_pa, m_ap):
+            return jnp.zeros((a, a), jnp.int32).at[
+                pn[:, None], aidx_t[None, :]
+            ].add(m_pa).at[aidx_t[:, None], pn[None, :]].add(m_ap)
+
+        inc_off = _edge_inc(off_pa, off_ap)
+        inc_drp = _edge_inc(drop_pa, drop_ap)
+        inc_cut = _edge_inc(cut_pa, cut_ap)
+        edge_off = tele.edge_offered + inc_off
+        edge_drp = tele.edge_dropped + inc_drp
+        edge_cut = tele.edge_cut + inc_cut
         cv_new = (commit_vid != val.NONE) & (pr.commit_vid == val.NONE)
         took = cv_new & ~newly  # [P, I] commit-takeover adoptions
         took_p = jnp.any(took, axis=1)  # [P]
+        # Phase-ledger stamps (write-once, like admit_round): learned
+        # when an Applied quorum (majority of nodes) holds the value;
+        # committed when the commit-until-all-acked ladder completed —
+        # some proposer's commitment acked by every non-crashed node.
+        # Both read state the round already computed; the [P, A, I]
+        # all-reduce is the armed build's cost, never the plain one's.
+        learn_ok = (
+            jnp.sum((learned != val.NONE).astype(jnp.int32), axis=0)
+            >= quorum
+        )  # [I]
+        full_ack = jnp.any(
+            (commit_vid != val.NONE)
+            & jnp.all(commit_acked | crashed[None, :, None], axis=1),
+            axis=0,
+        )  # [I]
         new_tele = _rec.Telemetry(
             offered=tele.offered + jnp.stack([c[0] for c in tc]),
             dropped=tele.dropped + jnp.stack([c[1] for c in tc]),
@@ -1897,6 +1938,14 @@ def build_engine(
                 (tele.admit_round == val.NONE) & _adm_any,
                 t, tele.admit_round,
             ),
+            learned_round=jnp.where(
+                (tele.learned_round == val.NONE) & learn_ok,
+                t, tele.learned_round,
+            ),
+            committed_round=jnp.where(
+                (tele.committed_round == val.NONE) & full_ack,
+                t, tele.committed_round,
+            ),
             takeover_round=jnp.where(
                 (tele.takeover_round == val.NONE) & took_p,
                 t, tele.takeover_round,
@@ -1904,13 +1953,20 @@ def build_engine(
             stall_max=jnp.maximum(tele.stall_max, jnp.max(stall)),
             edge_offered=edge_off,
             edge_dropped=edge_drp,
+            edge_cut=edge_cut,
         )
         if not _ww:
             return new_st, new_tele
         # Windowed plane: the same already-computed values, bucketed
         # by the virtual round (decision-time series are derived at
         # the epilogue from chosen_round — no accumulation needed).
+        # node_offered/node_delay charge each copy to BOTH endpoints
+        # (inc matrices summed along each axis), so a gray node's
+        # delay inflation shows on its row whichever direction the
+        # traffic flows; backlog is the post-round queue depth summed
+        # over proposers (tail - head counts not-yet-assigned values).
         wb = _rec.window_bucket(t, _ww)
+        inc_delay = _edge_inc(dsum_pa, dsum_ap)
         new_wins = _rec.TelemetryWindows(
             offered=wins.offered.at[wb].add(
                 sum(c[0] for c in tc)
@@ -1924,6 +1980,16 @@ def build_engine(
             ),
             restarts=wins.restarts.at[wb].add(
                 jnp.sum(do_restart, dtype=jnp.int32)
+            ),
+            cut=wins.cut.at[wb].add(jnp.sum(inc_cut, dtype=jnp.int32)),
+            backlog_max=wins.backlog_max.at[wb].max(
+                jnp.sum(tail - head, dtype=jnp.int32)
+            ),
+            node_offered=wins.node_offered.at[wb].add(
+                inc_off.sum(axis=0) + inc_off.sum(axis=1)
+            ),
+            node_delay=wins.node_delay.at[wb].add(
+                inc_delay.sum(axis=0) + inc_delay.sum(axis=1)
             ),
         )
         return new_st, (new_tele, new_wins)
@@ -2122,7 +2188,8 @@ def _run_loop_knobs(cfg: SimConfig, round_fn):
 
 
 def _run_loop_telemetry(
-    cfg: SimConfig, round_fn, window_rounds: int = 0, region_map=None
+    cfg: SimConfig, round_fn, window_rounds: int = 0, region_map=None,
+    return_ledger: bool = False,
 ):
     """Whole-run driver for a ``telemetry=True`` engine: the loop
     carries ``(state, Telemetry)`` and the epilogue reduces the
@@ -2158,16 +2225,33 @@ def _run_loop_telemetry(
 
         final, tl = jax.lax.while_loop(cond, body, (state, tele))
         if not ww:
-            return final, telem.summarize(tl, final, horizon, rmap)
-        base, wins = tl
-        return (
-            final,
-            telem.summarize(base, final, horizon, rmap),
-            telem.summarize_windows(
-                wins, base.admit_round, final.met.chosen_vid,
-                final.met.chosen_round, ww,
-            ),
-        )
+            base = tl
+            out = (final, telem.summarize(tl, final, horizon, rmap))
+        else:
+            base, wins = tl
+            out = (
+                final,
+                telem.summarize(base, final, horizon, rmap),
+                telem.summarize_windows(
+                    wins, base.admit_round, final.met.chosen_vid,
+                    final.met.chosen_round, ww,
+                    batch_round=base.admit_round,
+                    learned_round=base.learned_round,
+                    committed_round=base.committed_round,
+                ),
+            )
+        if return_ledger:
+            # the per-instance phase ledger, for OFFLINE export only
+            # (the Perfetto flow spans): a trailing output of the same
+            # traced loop, transferred post-run — the serving/fleet
+            # hot paths never build with this flag
+            out = out + ({
+                "admit_round": base.admit_round,
+                "batch_round": base.admit_round,
+                "learned_round": base.learned_round,
+                "committed_round": base.committed_round,
+            },)
+        return out
 
     return _go
 
@@ -2178,6 +2262,7 @@ def run_with_telemetry(
     gates: list[np.ndarray] | None = None,
     window_rounds: int | None = None,
     region_map=None,
+    return_ledger: bool = False,
 ):
     """``run()`` with the flight recorder armed: returns ``(SimResult,
     TelemetrySummary, WindowSummary | None)`` (summary fields as host
@@ -2186,7 +2271,13 @@ def run_with_telemetry(
     tests/test_telemetry.py).  ``window_rounds`` sets the windowed
     plane's bucket width (default :data:`~tpu_paxos.telemetry.
     recorder.WINDOW_ROUNDS`; pass 0 for the window-free PR-6-shaped
-    recorder, whose WindowSummary slot comes back None)."""
+    recorder, whose WindowSummary slot comes back None).
+
+    ``return_ledger=True`` (offline export only — Perfetto flow
+    spans) appends the per-instance phase-ledger dict (admit / batch /
+    learned / committed rounds, host numpy) as a fourth element; the
+    flag selects a traced program with the ledger as a trailing
+    output, so hot-path callers must leave it off."""
     from tpu_paxos.telemetry import recorder as telem
 
     if window_rounds is None:
@@ -2205,20 +2296,24 @@ def run_with_telemetry(
         window_rounds=ww,
     )
     _go = _run_loop_telemetry(
-        cfg, round_fn, window_rounds=ww, region_map=region_map
+        cfg, round_fn, window_rounds=ww, region_map=region_map,
+        return_ledger=return_ledger,
     )
     tele0 = telem.init_telemetry(cfg.n_instances, len(cfg.proposers), cfg.n_nodes)
     if ww:
-        tele0 = (tele0, telem.init_windows())
+        tele0 = (tele0, telem.init_windows(cfg.n_nodes))
     with tracecount.engine_scope("sim"):
         out = _go(root, state, tele0)
     final, summ = out[0], out[1]
     wsum = out[2] if ww else None
-    return (
+    ret = (
         to_result(final, expected),
         jax.tree.map(np.asarray, summ),
         jax.tree.map(np.asarray, wsum) if wsum is not None else None,
     )
+    if return_ledger:
+        ret = ret + (jax.tree.map(np.asarray, out[-1]),)
+    return ret
 
 
 def to_result(final: SimState, expected_vids: np.ndarray) -> SimResult:
@@ -2405,7 +2500,7 @@ def audit_entries():
         )
         tele0 = (
             telem.init_telemetry(cfg.n_instances, len(cfg.proposers), cfg.n_nodes),
-            telem.init_windows(),
+            telem.init_windows(cfg.n_nodes),
         )
         return (
             _run_loop_telemetry(cfg, rf, window_rounds=ww),

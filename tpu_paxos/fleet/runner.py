@@ -231,7 +231,7 @@ class FleetRunner:
                     telem.init_telemetry(
                         cfg.n_instances, len(cfg.proposers), cfg.n_nodes
                     ),
-                    telem.init_windows(),
+                    telem.init_windows(cfg.n_nodes),
                 )
                 final, (tl, ws) = jax.lax.while_loop(
                     cond,
@@ -245,6 +245,9 @@ class FleetRunner:
                     telem.summarize_windows(
                         ws, tl.admit_round, final.met.chosen_vid,
                         final.met.chosen_round, telem.WINDOW_ROUNDS,
+                        batch_round=tl.admit_round,
+                        learned_round=tl.learned_round,
+                        committed_round=tl.committed_round,
                     ),
                 )
         else:

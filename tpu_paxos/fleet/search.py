@@ -232,6 +232,15 @@ def _generation_margins(rep) -> dict:
         )
         out["latency_p99_series"] = agg["windows"]["latency_p99"]
         out["drop_series"] = agg["windows"]["dropped"]
+        # breach attribution over the generation's aggregate series
+        # (telemetry/diagnose.py): the top cause per active bucket —
+        # a schedule that saturates reads differently from one that
+        # grays a region, and the selection loop can weight them
+        from tpu_paxos.telemetry import diagnose as diag
+
+        out["cause_series"] = diag.label_windows(
+            agg["windows"], region_pairs=agg.get("region_pairs")
+        )
     return out
 
 

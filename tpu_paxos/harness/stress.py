@@ -191,6 +191,13 @@ WAN_REGIONS = {
     "wan-3region": wanm.node_regions(wanm.WAN3, 5),
     "wan-5region": wanm.node_regions(wanm.WAN5, 5),
 }
+#: preset region names per WAN mix label — the recorder's
+#: ``region_pairs`` blocks render pairs by NAME (``us->ap``), not
+#: bare index, wherever a preset is in scope
+WAN_NAMES = {
+    "wan-3region": wanm.WAN3.regions,
+    "wan-5region": wanm.WAN5.regions,
+}
 
 N_IDS = 6  # ids per client chain (gated, in-order)
 N_FREE = 8  # ungated values per proposer
@@ -335,7 +342,7 @@ def sweep(
     }
 
 
-def _mix_telemetry(rep, cfg: SimConfig) -> dict:
+def _mix_telemetry(rep, cfg: SimConfig, region_names: tuple = ()) -> dict:
     """One mix's flight-recorder block: every value is a pure function
     of (cfg, seeds) — no wall clock — so the block is golden-testable
     (tests/test_telemetry.py pins it against
@@ -357,7 +364,10 @@ def _mix_telemetry(rep, cfg: SimConfig) -> dict:
     ts = rep.telemetry
     if ts is None:
         return {}
-    agg = telem.reduce_lanes(ts, getattr(rep, "windows", None))
+    agg = telem.reduce_lanes(
+        ts, getattr(rep, "windows", None),
+        region_names=tuple(region_names),
+    )
     offered, dropped = agg["offered"], agg["dropped"]
     return {
         **{k: agg[k] for k in (
@@ -460,7 +470,9 @@ def sweep_fleet(
             compiles_per_mix[label] = (
                 census.engine_counts.get("fleet", 0) - before
             )
-            telemetry_per_mix[label] = _mix_telemetry(rep, cfg)
+            telemetry_per_mix[label] = _mix_telemetry(
+                rep, cfg, region_names=WAN_NAMES.get(label, ())
+            )
             runs += n_seeds
             lanes_total += n_seeds
             lane_seconds += rep.seconds

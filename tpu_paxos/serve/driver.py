@@ -112,7 +112,7 @@ def init_serve_state(
         cfg.n_instances, len(cfg.proposers), cfg.n_nodes
     )
     if window_rounds:
-        tele = (tele, telem.init_windows())
+        tele = (tele, telem.init_windows(cfg.n_nodes))
     ingest = jnp.full((int(vid_bound),), val.NONE, jnp.int32)
     return ServeLoopState(sim=st, tele=tele, ingest=ingest), c
 
@@ -190,8 +190,15 @@ def build_serve_window(
             return ServeLoopState(st, tl, ingest), st.done, st.t, summ
         base, wins = tl
         summ = telem.summarize(base._replace(admit_round=adm), st, 0)
+        # the windowed epilogue decomposes phases against the phase
+        # ledger: queue-wait = first-accept-batch minus INGEST (the
+        # serving queue's real wait), consensus/commit/learn from the
+        # in-loop stamps
         wsum = telem.summarize_windows(
-            wins, adm, st.met.chosen_vid, st.met.chosen_round, ww
+            wins, adm, st.met.chosen_vid, st.met.chosen_round, ww,
+            batch_round=base.admit_round,
+            learned_round=base.learned_round,
+            committed_round=base.committed_round,
         )
         return ServeLoopState(st, tl, ingest), st.done, st.t, summ, wsum
 

@@ -302,6 +302,10 @@ class ServeReport:
     #: already named a breach window — the burn-rate monitor's
     #: per-dispatch output; None = never breached (or no SLO)
     slo_first_breach_dispatch: int | None = None
+    #: the final device ServeLoopState (``keep_state=True`` only —
+    #: offline export reads the per-instance phase ledger out of it;
+    #: the serving loop itself never holds the reference)
+    final_state: object | None = None
 
     @property
     def values_per_sec(self) -> float:
@@ -321,6 +325,7 @@ def serve_run(
     slo: ServeSLO | None = None,
     region_map=None,
     region_names: tuple = (),
+    keep_state: bool = False,
 ) -> ServeReport:
     """Serve one value stream open-loop to completion (or the round
     budget).  ``workload[p]`` is proposer ``p``'s vid sequence in
@@ -473,7 +478,9 @@ def serve_run(
         jax.tree.map(np.asarray, last_wsum) if last_wsum is not None
         else None
     )
-    sd = telem.summary_to_dict(host_summ, host_wsum, ww)
+    sd = telem.summary_to_dict(
+        host_summ, host_wsum, ww, region_names=tuple(region_names)
+    )
     hist = np.asarray(host_summ.lat_hist)
     lat_max = int(host_summ.lat_max)
     decided_values = int(hist.sum())
@@ -501,6 +508,18 @@ def serve_run(
                     region_names=region_names)
         if slo is not None and windows_dict is not None else None
     )
+    if slo_dict is not None:
+        # breach attribution (telemetry/diagnose.py): label every
+        # named breach window with its ranked causes — pure host
+        # arithmetic on the already-harvested series
+        from tpu_paxos.telemetry import diagnose as diag
+
+        diag.attach_diagnosis(
+            slo_dict, windows_dict,
+            region_map=region_map, region_names=tuple(region_names),
+            region_pairs=sd.get("region_pairs"),
+            region_series=region_hists,
+        )
     return ServeReport(
         cfg=cfg,
         n_values=plan.n_values,
@@ -531,6 +550,7 @@ def serve_run(
         ),
         region_windows=region_hists,
         region_names=tuple(region_names),
+        final_state=ss if keep_state else None,
     )
 
 
@@ -691,6 +711,14 @@ def sweep_load(
             # story the per-point run-total columns cannot tell
             "breach_windows": {
                 str(pt["rate_milli"]): pt["slo"]["breach_windows"]
+                for pt in points if "slo" in pt
+            },
+            # breach attribution per rate: the diagnosis plane's
+            # named causes (telemetry/diagnose.py) — why each rate's
+            # windows breached, not just that they did
+            "breach_causes": {
+                str(pt["rate_milli"]):
+                    pt["slo"].get("diagnosis", {}).get("causes", [])
                 for pt in points if "slo" in pt
             },
             "ok": all(

@@ -4,7 +4,10 @@
 counters, the per-instance latency ledger, near-miss margins) that the
 engines carry through their traced round loops when built with
 ``telemetry=True``; ``export`` renders host-side summaries as
-Chrome-trace/Perfetto JSON timelines (``python -m tpu_paxos trace``).
+Chrome-trace/Perfetto JSON timelines (``python -m tpu_paxos trace``);
+``diagnose`` is the deterministic breach-attribution classifier over
+the harvested windowed series (saturation / gray-region / partition /
+duel-churn, ranked per breach window).
 
 Submodules are lazily re-exported (PEP 562), mirroring ``core`` and
 ``fleet``: ``recorder`` is imported by ``core.sim`` only when an
@@ -12,7 +15,7 @@ engine is telemetry-armed, and importing the package must not eagerly
 drag in jax or the harness stack.
 """
 
-_SUBMODULES = ("recorder", "export")
+_SUBMODULES = ("recorder", "export", "diagnose")
 
 
 def __getattr__(name):

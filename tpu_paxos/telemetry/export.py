@@ -56,9 +56,18 @@ ROUND_US = 1000
 #: overrides per render.
 MAX_DECISION_EVENTS = 1024
 
+#: Default cap on per-instance PHASE FLOW samples: each sampled
+#: instance renders its queue/consensus/commit/learn spans on its own
+#: row of the ``phases`` process, linked by a flow arrow, so one
+#: value's whole life is one connected path through the timeline.
+#: The first N decided instances by decision round are sampled
+#: (deterministic); ``--max-flow-instances`` overrides.
+MAX_FLOW_INSTANCES = 64
+
 _NET_TRACK = "network"
 _DECISION_TRACK = "decisions"
 _TELEMETRY_TRACK = "telemetry"
+_PHASES_TRACK = "phases"
 
 
 def _ev(ph, name, pid, tid=0, ts=0, **kw):
@@ -160,7 +169,102 @@ def _window_counter_events(windows: dict, tele_pid: int) -> list:
     counter("decided / window", windows["decided"])
     counter("stall depth", windows["stall_max"])
     counter("takeovers / window", windows["takeovers"])
+    # PR-15 series: the diagnosis plane's inputs as visible curves —
+    # queue depth (saturation), severed-edge losses (partition), and
+    # the per-phase latency decomposition (queue-dominated vs
+    # consensus-dominated reads directly off the stacked curves)
+    if "backlog_max" in windows:
+        counter("queue backlog", windows["backlog_max"])
+        counter("cut copies / window", windows["cut"])
+        for name, series in windows.get("phase_p50", {}).items():
+            counter(f"phase {name} p50 (rounds)", series,
+                    skip_neg=True)
     return events
+
+
+def _diagnosis_events(diagnosis: dict, tele_pid: int) -> list:
+    """Breach-attribution annotations (telemetry/diagnose.py): one
+    instant per diagnosed window at the window's start, named by its
+    top cause, with the full ranked candidate list in args — an
+    ambiguous window announces every qualifying cause."""
+    events = []
+    for v in (diagnosis or {}).get("windows", ()):
+        ranked = "+".join(c["cause"] for c in v["candidates"]) or "unknown"
+        events.append(_ev(
+            "i", f"breach w{v['window']}: {ranked}", tele_pid,
+            ts=int(v["span"][0]) * ROUND_US, s="p",
+            args={
+                "window": v["window"],
+                "cause": v["cause"],
+                "ambiguous": v["ambiguous"],
+                "candidates": v["candidates"],
+            },
+        ))
+    return events
+
+
+def _phase_flow_events(
+    phase_ledger: dict,
+    chosen_vid,
+    chosen_round,
+    phases_pid: int,
+    max_instances: int = MAX_FLOW_INSTANCES,
+) -> tuple[list, int, int]:
+    """Causal per-instance phase spans: for a bounded sample of
+    decided instances (first N by decision round — deterministic),
+    one row of ``X`` slices per instance (queue / consensus / commit /
+    learn, where each stamp exists) linked by a flow arrow
+    (``s``/``t``/``f`` with the vid as flow id), so one value's whole
+    life reads as a connected path.  Returns ``(events, rendered,
+    dropped)``."""
+    from tpu_paxos.core import values as val
+
+    admit = np.asarray(phase_ledger["admit_round"])
+    batch = np.asarray(phase_ledger["batch_round"])
+    learned = np.asarray(phase_ledger["learned_round"])
+    committed = np.asarray(phase_ledger["committed_round"])
+    chosen_vid = np.asarray(chosen_vid)
+    chosen_round = np.asarray(chosen_round)
+    none = int(val.NONE)
+    decided = np.flatnonzero(
+        (chosen_vid != none) & (admit != none) & (batch != none)
+    )
+    order = decided[np.argsort(chosen_round[decided], kind="stable")]
+    cap = max(0, int(max_instances))
+    events = []
+    for slot, i in enumerate(order[:cap].tolist()):
+        spans = [
+            # queue-wait renders only where it exists (ingest-stamped
+            # serve runs); the closed loop admits AT the first batch
+            ("queue", int(admit[i]), int(batch[i]), True),
+            ("consensus", int(batch[i]), int(chosen_round[i]), False),
+            ("commit", int(chosen_round[i]), int(committed[i]), False),
+            ("learn", int(chosen_round[i]), int(learned[i]), False),
+        ]
+        fid = int(chosen_vid[i])
+        flow = []
+        for name, t0, t1, skip_empty in spans:
+            if t0 < 0 or t1 < 0 or t1 < t0 or (skip_empty and t1 == t0):
+                continue
+            ts = t0 * ROUND_US
+            events.append(_ev(
+                "X", f"{name} [{i}]", phases_pid, tid=slot, ts=ts,
+                dur=max((t1 - t0) * ROUND_US, 1),
+                args={"instance": i, "vid": fid, "t0": t0, "t1": t1,
+                      "rounds": t1 - t0},
+            ))
+            flow.append(_ev(
+                "t", f"value {fid}", phases_pid, tid=slot, ts=ts,
+                id=fid, cat="phase",
+            ))
+        if flow:
+            flow[0]["ph"] = "s"
+            if len(flow) > 1:
+                flow[-1]["ph"] = "f"
+                flow[-1]["bp"] = "e"
+            events.extend(flow)
+    rendered = min(len(order), cap)
+    return events, rendered, max(0, len(order) - cap)
 
 
 def _region_counter_events(
@@ -176,24 +280,41 @@ def _region_counter_events(
     n = int(region_pairs.get("n_regions", 1))
     if n <= 1:
         return events
+    from tpu_paxos.telemetry import recorder as telem
+
+    names = telem.region_prefix_names(
+        region_pairs.get("names", ()), n
+    )
     rates = region_pairs["drop_rate_observed"]
     offered = region_pairs["offered"]
+    cut = region_pairs.get("cut")
     for s in range(n):
         for d in range(n):
-            if not offered[s][d]:
+            if not offered[s][d] and not (cut and cut[s][d]):
                 continue
-            name = f"region drop r{s}->r{d} (/1e4)"
+            pair = f"{names[s]}->{names[d]}"
+            name = f"region drop {pair} (/1e4)"
             for ts in (0, t_end_us):
                 events.append(_ev(
                     "C", name, tele_pid, ts=ts,
                     args={name: rates[s][d]},
                 ))
+            if cut and cut[s][d]:
+                cname = f"region cut {pair} (copies)"
+                for ts in (0, t_end_us):
+                    events.append(_ev(
+                        "C", cname, tele_pid, ts=ts,
+                        args={cname: cut[s][d]},
+                    ))
     return events
 
 
 def chrome_trace(
     cfg, result, summary_dict=None, label="tpu-paxos",
     max_decision_events: int = MAX_DECISION_EVENTS,
+    phase_ledger: dict | None = None,
+    diagnosis: dict | None = None,
+    max_flow_instances: int = MAX_FLOW_INSTANCES,
 ) -> dict:
     """Build the Chrome-trace dict for one run.
 
@@ -204,11 +325,18 @@ def chrome_trace(
     tracks on a dedicated telemetry process.  ``max_decision_events``
     caps the per-instance decision instants; hitting the cap emits a
     visible "N decision instants dropped" annotation at the cap
-    point instead of truncating silently."""
+    point instead of truncating silently.
+
+    ``phase_ledger`` (the per-instance admit/batch/learned/committed
+    stamps, ``sim.run_with_telemetry(return_ledger=True)``) adds the
+    CAUSAL plane: a bounded sample of instances rendered as
+    flow-linked queue/consensus/commit/learn spans on a ``phases``
+    process.  ``diagnosis`` (telemetry/diagnose.py output) adds
+    breach-attribution annotation instants on the telemetry track."""
     from tpu_paxos.core import values as val
 
     a = cfg.n_nodes
-    net_pid, dec_pid, tele_pid = a, a + 1, a + 2
+    net_pid, dec_pid, tele_pid, phase_pid = a, a + 1, a + 2, a + 3
     windows = (summary_dict or {}).get("windows")
     events = []
     for node in range(a):
@@ -219,11 +347,20 @@ def chrome_trace(
     if windows is not None:
         _meta(events, tele_pid, _TELEMETRY_TRACK)
         events += _window_counter_events(windows, tele_pid)
+        events += _diagnosis_events(diagnosis, tele_pid)
     region_pairs = (summary_dict or {}).get("region_pairs")
     if region_pairs is not None and windows is not None:
         events += _region_counter_events(
             region_pairs, tele_pid, int(result.rounds) * ROUND_US
         )
+    flows_rendered = flows_dropped = 0
+    if phase_ledger is not None:
+        _meta(events, phase_pid, _PHASES_TRACK)
+        flow_ev, flows_rendered, flows_dropped = _phase_flow_events(
+            phase_ledger, result.chosen_vid, result.chosen_round,
+            phase_pid, max_flow_instances,
+        )
+        events += flow_ev
     events += _episode_events(cfg.faults.schedule, a, net_pid)
 
     # decisions: instants on the decision track + a cumulative counter
@@ -286,6 +423,11 @@ def chrome_trace(
         "decision_events_cap": cap,
         "round_us": ROUND_US,
     }
+    if phase_ledger is not None:
+        other["flow_instances"] = flows_rendered
+        other["flow_instances_dropped"] = flows_dropped
+    if diagnosis is not None:
+        other["diagnosis"] = diagnosis
     if summary_dict is not None:
         other["telemetry"] = summary_dict
     return {
@@ -296,24 +438,32 @@ def chrome_trace(
 
 
 def trace_artifact(
-    path: str, max_decision_events: int = MAX_DECISION_EVENTS
+    path: str, max_decision_events: int = MAX_DECISION_EVENTS,
+    max_flow_instances: int = MAX_FLOW_INSTANCES,
 ) -> dict:
     """Re-execute a repro artifact with the flight recorder armed
     (windowed plane included — the counter tracks come from it) and
-    render the Chrome trace.  Telemetry is recomputed at replay —
-    never read from (or written to) the artifact, whose schema stays
-    closed."""
+    render the Chrome trace: counter tracks, the per-instance phase
+    flow spans, and the diagnosis plane's cause annotations.
+    Telemetry is recomputed at replay — never read from (or written
+    to) the artifact, whose schema stays closed."""
     from tpu_paxos.core import sim as simm
     from tpu_paxos.harness import shrink as shr
+    from tpu_paxos.telemetry import diagnose as diag
     from tpu_paxos.telemetry import recorder as telem
 
     case, art = shr.load_artifact(path)
+    ledger = diagnosis = None
     if case.engine == "sim":
-        result, summ, wsum = simm.run_with_telemetry(
-            case.cfg, case.workload, case.gates
+        result, summ, wsum, ledger = simm.run_with_telemetry(
+            case.cfg, case.workload, case.gates, return_ledger=True
         )
         summary_dict = telem.summary_to_dict(
             summ, wsum, telem.WINDOW_ROUNDS
+        )
+        diagnosis = diag.diagnose_series(
+            summary_dict["windows"],
+            region_pairs=summary_dict["region_pairs"],
         )
     else:
         # sharded replays are recorder-free (build_engine rejects
@@ -323,6 +473,9 @@ def trace_artifact(
     trace = chrome_trace(
         case.cfg, result, summary_dict, label=path,
         max_decision_events=max_decision_events,
+        phase_ledger=ledger,
+        diagnosis=diagnosis,
+        max_flow_instances=max_flow_instances,
     )
     trace["otherData"]["artifact"] = path
     trace["otherData"]["recorded_violation"] = art["violation"]
@@ -330,18 +483,226 @@ def trace_artifact(
     return trace
 
 
+def _serve_ledger(tele_pair, ingest: np.ndarray, chosen_vid) -> dict:
+    """The phase-ledger dict for one serve stream: admission from the
+    INGEST table (the serving queue's real wait — one owner of the
+    hole-fill/out-of-table rules: ``recorder.serve_admit_rounds``),
+    batch/learned/committed from the in-loop recorder stamps.
+    Post-clock transfers only."""
+    import jax.numpy as jnp
+
+    from tpu_paxos.telemetry import recorder as telem
+
+    base = tele_pair[0]
+    return {
+        "admit_round": np.asarray(telem.serve_admit_rounds(
+            jnp.asarray(ingest), jnp.asarray(chosen_vid)
+        )),
+        "batch_round": np.asarray(base.admit_round),
+        "learned_round": np.asarray(base.learned_round),
+        "committed_round": np.asarray(base.committed_round),
+    }
+
+
+def trace_serve(args) -> dict:
+    """``python -m tpu_paxos trace --serve`` — run an open-loop serve
+    (or serve-fleet) stream and render its windowed series, phase
+    flow spans, and breach-attribution annotations as a Perfetto
+    timeline.  The pre-PR-15 ``trace`` could only replay repro
+    artifacts; serving runs — where the SLO monitor and the diagnosis
+    plane actually live — had no visual form."""
+    import types
+
+    from tpu_paxos.config import FaultConfig, SimConfig
+    from tpu_paxos.core import wan as wanm
+    from tpu_paxos.serve import arrivals as arrv
+    from tpu_paxos.serve import harness as sh
+    from tpu_paxos.telemetry import diagnose as diag
+
+    preset = wanm.PRESETS[args.preset] if args.preset else None
+    if preset is not None:
+        faults = wanm.wan_fault_config(preset, args.nodes)
+        region_map = wanm.node_regions(preset, args.nodes)
+        region_names = preset.regions
+    else:
+        faults = FaultConfig(
+            drop_rate=args.drop_rate, dup_rate=args.dup_rate,
+            max_delay=args.max_delay, crash_rate=args.crash_rate,
+        )
+        region_map, region_names = None, ()
+    n_values = int(args.values)
+    cfg = SimConfig(
+        n_nodes=args.nodes,
+        n_instances=max(64, 2 * n_values),
+        proposers=tuple(range(args.proposers)),
+        seed=args.seed,
+        max_rounds=args.max_rounds,
+        faults=faults,
+    )
+    slo = (
+        sh.ServeSLO(latency_rounds=args.slo_latency,
+                    budget_milli=args.slo_budget_milli)
+        if args.slo_latency else None
+    )
+    rate = int(args.rate_milli)
+    if args.lanes > 1:
+        from tpu_paxos.serve import fleet as sfleet
+
+        lanes = sfleet.fleet_lanes(
+            cfg, args.lanes, n_values, rate, args.seed, args.arrivals
+        )
+        frep = sfleet.serve_fleet_run(
+            cfg, lanes,
+            rounds_per_window=args.rounds_per_window,
+            windows_per_dispatch=args.windows_per_dispatch,
+            slo=slo,
+            region_map=region_map, region_names=region_names,
+        )
+        li = int(args.lane)
+        if not 0 <= li < frep.n_lanes:
+            raise SystemExit(
+                f"--lane {li} out of range for --lanes {frep.n_lanes}"
+            )
+        import jax
+
+        sd = frep.lane_summary(li)
+        tele_pair = jax.tree.map(lambda x: x[li], frep.final.tele)
+        ingest = np.asarray(frep.final.ingest[li])
+        met = frep.final.sim.met
+        chosen_vid = np.asarray(met.chosen_vid[li])
+        result = types.SimpleNamespace(
+            chosen_vid=chosen_vid,
+            chosen_round=np.asarray(met.chosen_round[li]),
+            chosen_ballot=np.asarray(met.chosen_ballot[li]),
+            rounds=frep.rounds, done=frep.done,
+        )
+        verdict = (frep.slo or {}).get(li)
+        diagnosis = (verdict or {}).get("diagnosis")
+        region_series = frep.lane_region_windows(li)
+        label = f"serve fleet lane {li}/{frep.n_lanes} @ {rate}/1000"
+        extra = {
+            "engine": "serve_fleet", "lane": li,
+            "lanes": frep.n_lanes,
+            "breach_lanes": [
+                int(i) for i in np.flatnonzero(frep.breach)
+            ],
+        }
+    else:
+        vids = np.arange(n_values, dtype=np.int32)
+        if rate <= 0:
+            rounds = arrv.immediate_rounds(n_values)
+        else:
+            rounds = arrv.ARRIVAL_BUILDERS[args.arrivals](
+                n_values, rate, args.seed
+            )
+        streams, arrs = arrv.split_round_robin(
+            vids, rounds, args.proposers
+        )
+        rep = sh.serve_run(
+            cfg, streams, arrs,
+            rounds_per_window=args.rounds_per_window,
+            windows_per_dispatch=args.windows_per_dispatch,
+            slo=slo,
+            region_map=region_map, region_names=region_names,
+            keep_state=True,
+        )
+        ss = rep.final_state
+        sd = rep.summary
+        tele_pair = ss.tele
+        ingest = np.asarray(ss.ingest)
+        chosen_vid = rep.chosen_vid
+        result = types.SimpleNamespace(
+            chosen_vid=rep.chosen_vid,
+            chosen_round=np.asarray(ss.sim.met.chosen_round),
+            chosen_ballot=rep.chosen_ballot,
+            rounds=rep.rounds, done=rep.done,
+        )
+        diagnosis = (rep.slo or {}).get("diagnosis")
+        region_series = rep.region_windows
+        label = f"serve @ {rate}/1000 ({args.arrivals})"
+        extra = {"engine": "serve", "slo_ok": (
+            rep.slo["ok"] if rep.slo is not None else None
+        )}
+    if diagnosis is None and sd.get("windows") is not None:
+        # no SLO (or no breach): annotate notable windows anyway
+        diagnosis = diag.diagnose_series(
+            sd["windows"],
+            region_map=region_map, region_names=tuple(region_names),
+            region_pairs=sd.get("region_pairs"),
+            region_series=region_series,
+        )
+    ledger = _serve_ledger(tele_pair, ingest, chosen_vid)
+    trace = chrome_trace(
+        cfg, result, sd, label=label,
+        max_decision_events=args.max_decision_events,
+        phase_ledger=ledger,
+        diagnosis=diagnosis,
+        max_flow_instances=args.max_flow_instances,
+    )
+    trace["otherData"].update(extra)
+    trace["otherData"]["rate_milli"] = rate
+    trace["otherData"]["arrivals"] = args.arrivals
+    if args.preset:
+        trace["otherData"]["preset"] = args.preset
+    return trace
+
+
 def main(argv=None) -> int:
     """``python -m tpu_paxos trace <artifact>`` — render a repro
     artifact as a Chrome-trace JSON timeline (open in
-    https://ui.perfetto.dev or chrome://tracing)."""
+    https://ui.perfetto.dev or chrome://tracing).  ``--serve`` runs
+    an open-loop serving stream instead and renders its windowed
+    series, phase spans, and diagnosis annotations."""
     ap = argparse.ArgumentParser(
         prog="python -m tpu_paxos trace",
-        description="render a stress-triage repro artifact as a "
+        description="render a stress-triage repro artifact — or, with "
+        "--serve, a fresh open-loop serving run — as a "
         "Chrome-trace/Perfetto timeline (telemetry recomputed at "
-        "replay; the artifact itself is never modified)",
+        "replay; artifacts are never modified)",
     )
-    ap.add_argument("artifact", help="path to a repro .json (written "
-                    "by the stress sweep's --triage-dir)")
+    ap.add_argument("artifact", nargs="?", default="",
+                    help="path to a repro .json (written by the "
+                    "stress sweep's --triage-dir); omit with --serve")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve mode: run an open-loop stream "
+                    "(serve/harness.py; --lanes N for a fleet "
+                    "lane) and export ITS timeline instead of "
+                    "replaying an artifact")
+    ap.add_argument("--values", type=int, default=128,
+                    help="[serve] values in the arriving stream")
+    ap.add_argument("--rate-milli", type=int, default=2000,
+                    help="[serve] offered load (values/1000 rounds; "
+                    "0 = everything at round 0)")
+    ap.add_argument("--arrivals", type=str, default="poisson",
+                    help="[serve] arrival process (serve/arrivals.py)")
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--proposers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-rounds", type=int, default=20_000)
+    ap.add_argument("--rounds-per-window", type=int, default=8)
+    ap.add_argument("--windows-per-dispatch", type=int, default=8)
+    ap.add_argument("--slo-latency", type=int, default=0,
+                    help="[serve] latency SLO in rounds (arms the "
+                    "burn-rate monitor + breach attribution)")
+    ap.add_argument("--slo-budget-milli", type=int, default=100)
+    ap.add_argument("--preset", type=str, default="",
+                    help="[serve] WAN topology preset (core/wan.py: "
+                    "wan-3region / wan-5region) — arms the per-edge "
+                    "fault matrices, the region map, and region-named "
+                    "breach attribution")
+    ap.add_argument("--lanes", type=int, default=1,
+                    help="[serve] >1: run a serve FLEET of this many "
+                    "tenant lanes and export --lane's timeline")
+    ap.add_argument("--lane", type=int, default=0,
+                    help="[serve] which fleet lane to export")
+    ap.add_argument("--drop-rate", type=int, default=0)
+    ap.add_argument("--dup-rate", type=int, default=0)
+    ap.add_argument("--max-delay", type=int, default=0)
+    ap.add_argument("--crash-rate", type=int, default=0)
+    ap.add_argument("--max-flow-instances", type=int,
+                    default=MAX_FLOW_INSTANCES,
+                    help="cap on flow-linked per-instance phase-span "
+                    "samples on the phases track")
     ap.add_argument("--out", type=str, default="",
                     help="write the trace JSON here (default: "
                     "<artifact>.trace.json)")
@@ -362,6 +723,33 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     import os
 
+    if bool(args.serve) == bool(args.artifact):
+        ap.error("exactly one of <artifact> or --serve required")
+    if args.serve:
+        # fail at the argparse boundary, not as an engine traceback
+        for flag, v, floor in (
+            ("--values", args.values, 1),
+            ("--rounds-per-window", args.rounds_per_window, 1),
+            ("--windows-per-dispatch", args.windows_per_dispatch, 1),
+            ("--lanes", args.lanes, 1),
+            ("--rate-milli", args.rate_milli, 0),
+            ("--slo-latency", args.slo_latency, 0),
+        ):
+            if v < floor:
+                ap.error(f"{flag} must be >= {floor} (got {v})")
+        if not 0 <= args.lane < args.lanes:
+            ap.error(
+                f"--lane {args.lane} out of range for "
+                f"--lanes {args.lanes}"
+            )
+    if args.preset:
+        from tpu_paxos.core import wan as wanm
+
+        if args.preset not in wanm.PRESETS:
+            ap.error(
+                f"unknown --preset {args.preset!r} "
+                f"(have: {', '.join(sorted(wanm.PRESETS))})"
+            )
     # same determinism surface as `repro`: replay output must not
     # capture wall clock
     os.environ.setdefault("TPU_PAXOS_DETERMINISTIC", "1")
@@ -373,13 +761,14 @@ def main(argv=None) -> int:
     # added after the backend initializes.  Malformed artifacts fall
     # through to load_artifact's clean exit-2 schema error.
     devices = 1
-    try:
-        with open(args.artifact) as f:
-            hdr = json.load(f)
-        if isinstance(hdr, dict) and hdr.get("engine") == "sharded":
-            devices = int(hdr.get("devices", 1))
-    except (OSError, ValueError, TypeError):
-        devices = 1
+    if not args.serve:
+        try:
+            with open(args.artifact) as f:
+                hdr = json.load(f)
+            if isinstance(hdr, dict) and hdr.get("engine") == "sharded":
+                devices = int(hdr.get("devices", 1))
+        except (OSError, ValueError, TypeError):
+            devices = 1
     if devices > 1:
         backend = "cpu" if args.backend == "auto" else args.backend
         _select_backend(backend, mesh=devices)
@@ -390,10 +779,14 @@ def main(argv=None) -> int:
 
     logger = logm.get_logger("trace", _level(args))
     try:
-        trace = trace_artifact(
-            args.artifact,
-            max_decision_events=args.max_decision_events,
-        )
+        if args.serve:
+            trace = trace_serve(args)
+        else:
+            trace = trace_artifact(
+                args.artifact,
+                max_decision_events=args.max_decision_events,
+                max_flow_instances=args.max_flow_instances,
+            )
     except ArtifactSchemaError as e:
         logger.error("%s", e)
         _emit(args, {
@@ -405,7 +798,10 @@ def main(argv=None) -> int:
     if args.stdout:
         sys.stdout.write(text + "\n")
         return 0
-    out = args.out or (args.artifact + ".trace.json")
+    out = args.out or (
+        (args.artifact + ".trace.json") if args.artifact
+        else "serve.trace.json"
+    )
     tmp = out + ".tmp"
     with open(tmp, "w") as f:
         f.write(text + "\n")

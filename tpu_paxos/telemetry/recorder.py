@@ -85,6 +85,20 @@ NUM_LAT_BUCKETS = len(LAT_EDGES) + 1
 NUM_WINDOWS = 16
 WINDOW_ROUNDS = 16
 
+#: The per-instance PHASE LEDGER's phase order (PR 15): every decided
+#: value's commit latency decomposes into queue-wait (ingest to first
+#: accept batch — zero on the closed loop, where admission IS the
+#: first batch), consensus (first batch to chosen), commit-ladder
+#: (chosen to fully commit-acked by every live node), and
+#: learn-propagation (chosen to learned by an Applied quorum).  The
+#: windowed decomposition (``WindowSummary.phase_hist``) is the
+#: diagnosis plane's primary input (telemetry/diagnose.py):
+#: queue-dominated latency says saturation, consensus-dominated says
+#: duel churn, commit/learn-dominated says a slow or dark receiver.
+PHASE_NAMES = ("queue", "consensus", "commit", "learn")
+NUM_PHASES = len(PHASE_NAMES)
+PHASE_QUEUE, PHASE_CONSENSUS, PHASE_COMMIT, PHASE_LEARN = range(NUM_PHASES)
+
 #: Fixed region capacity of the per-REGION-pair fault counters: the
 #: node->region assignment is a RUNTIME ``[A]`` int32 map (clamped
 #: into this bound), so one compiled program serves every WAN
@@ -114,6 +128,12 @@ class Telemetry(NamedTuple):
     requeues: np.ndarray  # int32 conflict requeues appended
     restarts: np.ndarray  # int32 proposer ballot restarts
     admit_round: np.ndarray  # [I] int32 first round in an accept batch
+    learned_round: np.ndarray  # [I] int32 first round an Applied
+    #     quorum (majority of nodes) had learned the instance (NONE:
+    #     never) — the phase ledger's learn-propagation stamp
+    committed_round: np.ndarray  # [I] int32 first round the commit
+    #     ladder completed: some proposer's commitment acked by every
+    #     non-crashed node (NONE: never) — the commit-ladder stamp
     takeover_round: np.ndarray  # [P] int32 first takeover round (NONE)
     stall_max: np.ndarray  # int32 max stall counter ever observed
     edge_offered: np.ndarray  # [A, A] int32 per-edge offered copies
@@ -122,6 +142,11 @@ class Telemetry(NamedTuple):
     #     totals, so a gray/lossy link is visible without an [A, A]
     #     series crossing per round
     edge_dropped: np.ndarray  # [A, A] int32 per-edge dropped copies
+    edge_cut: np.ndarray  # [A, A] int32 per-edge copies lost at a
+    #     SEVERED edge (pre-cut send mask minus post-cut): offered
+    #     stays post-cut for drop-rate exactness, so partitions are
+    #     invisible in the drop counters — this counter is where they
+    #     show, and region_cut names the severed pair
 
 
 class TelemetryWindows(NamedTuple):
@@ -142,6 +167,18 @@ class TelemetryWindows(NamedTuple):
     stall_max: np.ndarray  # [W] int32 max stall depth seen in bucket
     takeovers: np.ndarray  # [W] int32 commit-takeover adoptions
     restarts: np.ndarray  # [W] int32 proposer ballot restarts
+    cut: np.ndarray  # [W] int32 copies lost at severed edges — the
+    #     partition signature the post-cut drop counters cannot show
+    backlog_max: np.ndarray  # [W] int32 max total queue backlog
+    #     (sum over proposers of tail - head) seen in the bucket —
+    #     growth across buckets is the saturation signature
+    node_offered: np.ndarray  # [W, A] int32 offered copies touching
+    #     each node (charged to BOTH endpoints) per bucket
+    node_delay: np.ndarray  # [W, A] int32 summed sampled delays of
+    #     surviving copies touching each node per bucket — divided by
+    #     node_offered this is a per-node mean-delay series: a gray
+    #     node's inflation is visible against its OWN earlier buckets
+    #     even on a WAN preset whose baseline is already asymmetric
 
 
 class WindowSummary(NamedTuple):
@@ -157,8 +194,18 @@ class WindowSummary(NamedTuple):
     stall_max: np.ndarray  # [W] int32
     takeovers: np.ndarray  # [W] int32
     restarts: np.ndarray  # [W] int32
+    cut: np.ndarray  # [W] int32
+    backlog_max: np.ndarray  # [W] int32
+    node_offered: np.ndarray  # [W, A] int32
+    node_delay: np.ndarray  # [W, A] int32
     decided: np.ndarray  # [W] int32 decisions per bucket
     lat_hist: np.ndarray  # [W, NUM_LAT_BUCKETS] int32 latency deltas
+    phase_hist: np.ndarray  # [W, NUM_PHASES, NUM_LAT_BUCKETS] int32
+    #     phase-latency decomposition (PHASE_NAMES order), derived at
+    #     the epilogue from the phase ledger: each decided value's
+    #     phases bin in the window of its DECISION round, so the
+    #     consensus row sums to lat_hist exactly on the closed loop
+    #     (queue-wait is zero there — admission IS the first batch)
 
 
 class TelemetrySummary(NamedTuple):
@@ -186,6 +233,8 @@ class TelemetrySummary(NamedTuple):
     quiescent: np.ndarray  # bool the engine's done predicate held
     region_offered: np.ndarray  # [R, R] int32 offered per region pair
     region_dropped: np.ndarray  # [R, R] int32 dropped per region pair
+    region_cut: np.ndarray  # [R, R] int32 copies lost at severed
+    #     edges per region pair — the partition attribution signal
 
 
 def init_telemetry(
@@ -206,14 +255,17 @@ def init_telemetry(
         requeues=jnp.int32(0),
         restarts=jnp.int32(0),
         admit_round=jnp.full((n_instances,), val.NONE, jnp.int32),
+        learned_round=jnp.full((n_instances,), val.NONE, jnp.int32),
+        committed_round=jnp.full((n_instances,), val.NONE, jnp.int32),
         takeover_round=jnp.full((n_proposers,), val.NONE, jnp.int32),
         stall_max=jnp.int32(0),
         edge_offered=jnp.zeros((n_nodes, n_nodes), jnp.int32),
         edge_dropped=jnp.zeros((n_nodes, n_nodes), jnp.int32),
+        edge_cut=jnp.zeros((n_nodes, n_nodes), jnp.int32),
     )
 
 
-def init_windows() -> TelemetryWindows:
+def init_windows(n_nodes: int) -> TelemetryWindows:
     """Zeroed windowed accumulators for one lane.  One DISTINCT
     buffer per field: the serve driver donates the whole loop state,
     and donating one buffer through two tree leaves is an XLA
@@ -223,9 +275,14 @@ def init_windows() -> TelemetryWindows:
     def z():
         return jnp.zeros((NUM_WINDOWS,), jnp.int32)
 
+    def za():
+        return jnp.zeros((NUM_WINDOWS, n_nodes), jnp.int32)
+
     return TelemetryWindows(
         offered=z(), dropped=z(), duped=z(), delayed=z(),
         stall_max=z(), takeovers=z(), restarts=z(),
+        cut=z(), backlog_max=z(),
+        node_offered=za(), node_delay=za(),
     )
 
 
@@ -248,6 +305,9 @@ def summarize_windows(
     chosen_vid,
     chosen_round,
     window_rounds: int,
+    batch_round=None,
+    learned_round=None,
+    committed_round=None,
 ) -> WindowSummary:
     """Close one lane's windowed series, on device: the accumulated
     rings pass through; per-bucket commit counts and latency-histogram
@@ -257,7 +317,20 @@ def summarize_windows(
     against ``LAT_EDGES`` exactly like the run-total histogram, so the
     windowed histograms sum to the cumulative one bucket-for-bucket).
     No-op fills count as decisions but never enter the latency series
-    (their admission stamp is NONE), matching :func:`summarize`."""
+    (their admission stamp is NONE), matching :func:`summarize`.
+
+    The PHASE LEDGER stamps (``batch_round`` = the in-loop
+    first-accept-batch ledger, ``learned_round``/``committed_round``
+    from :class:`Telemetry`) additionally derive the ``[W, NUM_PHASES,
+    B]`` phase-latency decomposition: queue-wait = batch - admission
+    (real only where admission is ingest-stamped — the serve path),
+    consensus = chosen - batch, commit-ladder = committed - chosen,
+    learn-propagation = learned - chosen.  All four phases gate on the
+    SAME population as ``lat_hist`` (decided, admission stamped), so
+    the consensus row equals ``lat_hist`` bucket-for-bucket on the
+    closed loop.  ``None`` ledger stamps (legacy callers) leave the
+    corresponding rows empty (``batch_round=None`` treats admission as
+    the batch stamp: queue-wait all-zero, consensus = the latency)."""
     import jax.numpy as jnp
 
     decided_mask = chosen_vid != val.NONE  # [I]
@@ -273,6 +346,35 @@ def summarize_windows(
     lat_hist = jnp.zeros(
         (NUM_WINDOWS, NUM_LAT_BUCKETS), jnp.int32
     ).at[wb, lb].add(lat_ok.astype(jnp.int32))
+    # ---- phase-latency decomposition (the phase ledger's epilogue)
+    if batch_round is None:
+        batch_round = admit_round
+    zero = jnp.zeros_like(lat)
+    q_ok = lat_ok & (batch_round != val.NONE)
+    q_dur = jnp.where(q_ok, jnp.maximum(batch_round - admit_round, 0), 0)
+    c_dur = jnp.where(q_ok, jnp.maximum(chosen_round - batch_round, 0), 0)
+    if committed_round is None:
+        com_ok, com_dur = jnp.zeros_like(lat_ok), zero
+    else:
+        com_ok = lat_ok & (committed_round != val.NONE)
+        com_dur = jnp.where(
+            com_ok, jnp.maximum(committed_round - chosen_round, 0), 0
+        )
+    if learned_round is None:
+        lrn_ok, lrn_dur = jnp.zeros_like(lat_ok), zero
+    else:
+        lrn_ok = lat_ok & (learned_round != val.NONE)
+        lrn_dur = jnp.where(
+            lrn_ok, jnp.maximum(learned_round - chosen_round, 0), 0
+        )
+    durs = jnp.stack([q_dur, c_dur, com_dur, lrn_dur], axis=1)  # [I, 4]
+    oks = jnp.stack([q_ok, q_ok, com_ok, lrn_ok], axis=1)  # [I, 4]
+    pb = jnp.sum(durs[:, :, None] > edges[None, None, :], axis=2)
+    phase_hist = jnp.zeros(
+        (NUM_WINDOWS, NUM_PHASES, NUM_LAT_BUCKETS), jnp.int32
+    ).at[
+        wb[:, None], jnp.arange(NUM_PHASES)[None, :], pb
+    ].add(oks.astype(jnp.int32))
     return WindowSummary(
         offered=wins.offered,
         dropped=wins.dropped,
@@ -281,8 +383,13 @@ def summarize_windows(
         stall_max=wins.stall_max,
         takeovers=wins.takeovers,
         restarts=wins.restarts,
+        cut=wins.cut,
+        backlog_max=wins.backlog_max,
+        node_offered=wins.node_offered,
+        node_delay=wins.node_delay,
         decided=decided,
         lat_hist=lat_hist,
+        phase_hist=phase_hist,
     )
 
 
@@ -464,24 +571,34 @@ def summarize(
         quiescent=final.done,
         region_offered=region_reduce(tele.edge_offered, region_map),
         region_dropped=region_reduce(tele.edge_dropped, region_map),
+        region_cut=region_reduce(tele.edge_cut, region_map),
     )
 
 
 # ---------------- host-side rendering ----------------
 
 
-def region_pairs_dict(region_offered, region_dropped) -> dict:
+def region_pairs_dict(
+    region_offered, region_dropped, region_cut=None, region_names=(),
+) -> dict:
     """The per-region-pair offered/dropped block, TRIMMED to the used
     region prefix (the [R, R] device shape is a fixed envelope; a
     3-region run renders 3x3).  Always at least 1x1 — region 0 holds
-    everything for unassigned runs."""
+    everything for unassigned runs.  ``region_cut`` adds the
+    severed-edge loss rows (partitions are invisible in the post-cut
+    drop counters); ``region_names`` adds preset region NAMES
+    (``core/wan.py`` — ``us``/``eu``/``ap``) so operators read pairs
+    by name, not index (short names fill in for regions past the
+    given prefix)."""
     off = np.asarray(region_offered)
     drp = np.asarray(region_dropped)
+    cut = None if region_cut is None else np.asarray(region_cut)
     used = np.flatnonzero(
         off.any(axis=0) | off.any(axis=1) | drp.any(axis=0) | drp.any(axis=1)
+        | (cut.any(axis=0) | cut.any(axis=1) if cut is not None else False)
     )
     r = int(used.max()) + 1 if used.size else 1
-    return {
+    out = {
         "n_regions": r,
         "offered": off[:r, :r].tolist(),
         "dropped": drp[:r, :r].tolist(),
@@ -493,6 +610,28 @@ def region_pairs_dict(region_offered, region_dropped) -> dict:
             for drow, orow in zip(drp[:r, :r], off[:r, :r])
         ],
     }
+    if cut is not None:
+        out["cut"] = cut[:r, :r].tolist()
+    if region_names:
+        out["names"] = region_prefix_names(region_names, r)
+    return out
+
+
+def region_prefix_names(region_names, r: int) -> list:
+    """The first ``r`` region names, padded with ``r<i>`` index names
+    past the declared prefix (a 5-node run on a 3-region preset never
+    pads; an undeclared region that somehow carried traffic still gets
+    a stable name)."""
+    names = [str(n) for n in region_names[:r]]
+    names += [f"r{i}" for i in range(len(names), r)]
+    return names
+
+
+def region_pair_name(region_names, s: int, d: int) -> str:
+    """One directed region pair as a name (``us->ap``), falling back
+    to index names without a preset in scope."""
+    names = region_prefix_names(region_names, max(s, d) + 1)
+    return f"{names[s]}->{names[d]}"
 
 
 def latency_quantile(hist: np.ndarray, q: float, lat_max: int) -> int:
@@ -515,6 +654,13 @@ def latency_quantile(hist: np.ndarray, q: float, lat_max: int) -> int:
     return int(lat_max)
 
 
+#: Phase-quantile clamp: phase durations are not bounded by the run's
+#: commit-latency max (the commit ladder and learn propagation finish
+#: AFTER the decision), so their bucket-edge quantiles clamp at twice
+#: the histogram grid instead of ``lat_max``.
+PHASE_LAT_CAP = 2 * LAT_EDGES[-1]
+
+
 def windows_to_dict(
     w: WindowSummary, window_rounds: int, lat_max: int
 ) -> dict:
@@ -523,7 +669,21 @@ def windows_to_dict(
     latency quantiles are bucket-edge estimates clamped to the RUN's
     observed max (``lat_max``); empty buckets report -1."""
     hist = np.asarray(w.lat_hist)  # [W, B]
+    phist = np.asarray(w.phase_hist)  # [W, NUM_PHASES, B]
     return {
+        "cut": np.asarray(w.cut).tolist(),
+        "backlog_max": np.asarray(w.backlog_max).tolist(),
+        "node_offered": np.asarray(w.node_offered).tolist(),
+        "node_delay": np.asarray(w.node_delay).tolist(),
+        "phases": list(PHASE_NAMES),
+        "phase_hist": phist.tolist(),  # [W][NUM_PHASES][B]
+        "phase_p50": {
+            name: [
+                latency_quantile(phist[wi, pi], 0.50, PHASE_LAT_CAP)
+                for wi in range(phist.shape[0])
+            ]
+            for pi, name in enumerate(PHASE_NAMES)
+        },
         "window_rounds": int(window_rounds),
         "n_windows": int(hist.shape[0]),
         "decided": np.asarray(w.decided).tolist(),
@@ -553,12 +713,14 @@ def summary_to_dict(
     s: TelemetrySummary,
     windows: WindowSummary | None = None,
     window_rounds: int = WINDOW_ROUNDS,
+    region_names: tuple = (),
 ) -> dict:
     """One lane's summary as a JSON-ready dict (plain ints/lists),
     with derived p50/p99 latency estimates; ``windows`` (one lane's
     :class:`WindowSummary`) adds the time-resolved ``"windows"``
-    block.  Under the fleet vmap index the summary first
-    (``jax.tree.map(lambda x: x[i], s)``)."""
+    block; ``region_names`` (a WAN preset's region tuple) names the
+    ``region_pairs`` block's rows.  Under the fleet vmap index the
+    summary first (``jax.tree.map(lambda x: x[i], s)``)."""
     hist = np.asarray(s.lat_hist)
     lat_max = int(s.lat_max)
     offered = np.asarray(s.offered)
@@ -594,7 +756,10 @@ def summary_to_dict(
         "takeover_round": np.asarray(s.takeover_round).tolist(),
         "rounds": int(s.rounds),
         "quiescent": bool(s.quiescent),
-        "region_pairs": region_pairs_dict(s.region_offered, s.region_dropped),
+        "region_pairs": region_pairs_dict(
+            s.region_offered, s.region_dropped, s.region_cut,
+            region_names,
+        ),
         **(
             {"windows": windows_to_dict(windows, window_rounds, lat_max)}
             if windows is not None else {}
@@ -631,8 +796,15 @@ def reduce_lanes_windows(
         stall_max=np.asarray(w.stall_max).max(axis=0),
         takeovers=np.asarray(w.takeovers).sum(axis=0),
         restarts=np.asarray(w.restarts).sum(axis=0),
+        cut=np.asarray(w.cut).sum(axis=0),
+        # backlog is a depth, not a rate: the deepest any lane queued
+        # in that bucket (summing would read lane count as pressure)
+        backlog_max=np.asarray(w.backlog_max).max(axis=0),
+        node_offered=np.asarray(w.node_offered).sum(axis=0),
+        node_delay=np.asarray(w.node_delay).sum(axis=0),
         decided=np.asarray(w.decided).sum(axis=0),
         lat_hist=np.asarray(w.lat_hist).sum(axis=0),
+        phase_hist=np.asarray(w.phase_hist).sum(axis=0),
     )
     return windows_to_dict(summed, window_rounds, lat_max)
 
@@ -656,6 +828,7 @@ def reduce_lanes(
     s: TelemetrySummary,
     windows: WindowSummary | None = None,
     window_rounds: int = WINDOW_ROUNDS,
+    region_names: tuple = (),
 ) -> dict:
     """Across-lane aggregate of a ``[lanes]``-leading summary stack —
     the ONE owner of the stack-reduction semantics (never-quiesced
@@ -677,6 +850,8 @@ def reduce_lanes(
         "region_pairs": region_pairs_dict(
             np.asarray(s.region_offered).sum(axis=0),
             np.asarray(s.region_dropped).sum(axis=0),
+            np.asarray(s.region_cut).sum(axis=0),
+            region_names,
         ),
         "offered": int(np.asarray(s.offered).sum()),
         "dropped": int(np.asarray(s.dropped).sum()),
