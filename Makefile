@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test test-slow check lint lint-json audit audit-json bench \
 	bench-sharded parity parity-fast replay-diff replay-diff-member \
 	run stress stress-quick fleet fleet-quick mc mc-quick serve \
-	serve-quick serve-fleet serve-fleet-quick clean
+	serve-quick serve-fleet serve-fleet-quick serve-control \
+	serve-control-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -57,7 +58,7 @@ audit-json:
 # un-jitted op-by-op smoke of one tiny config per engine (every cond
 # predicate, slice bound, and dtype materializes eagerly).  The pallas
 # interpreter path is part of the fast tier (tests/test_fastwin.py).
-check: lint audit mc-quick serve-quick serve-fleet-quick
+check: lint audit mc-quick serve-quick serve-fleet-quick serve-control-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
@@ -174,6 +175,31 @@ serve-fleet-quick:
 	$(PY) -m tpu_paxos serve --fleet --lanes 2 --values 48 \
 	  --rate-milli 4000 --slo-latency 128 \
 	  --drop-rate 500 --dup-rate 1000 --max-delay 2
+
+# Adaptive serving (tpu_paxos/serve/control.py): THE spike A/B
+# judgment at the committed BENCH_serve_control.json shape — a 4x
+# mid-run load spike on an admission-capped engine (assign_window=8),
+# served controller-off then controller-on at the same offered
+# trajectory.  Exits non-zero unless controller-on names strictly
+# fewer breach windows, sheds only outside gray-region-attributed
+# windows, and actually shed something.  Engine seed 3, arrivals
+# seed 0 (the decoupled pair the committed record pins).
+serve-control:
+	$(PY) -m tpu_paxos serve --control-ab --nodes 3 --values 1000 \
+	  --rate-milli 2000 --spike-factor 4 --spike-start-frac 0.25 \
+	  --spike-len-frac 0.5 --slo-latency 16 --slo-budget-milli 150 \
+	  --rounds-per-window 4 --windows-per-dispatch 2 \
+	  --window-rounds 32 --instances 2048 --assign-window 8 \
+	  --max-rounds 8000 --seed 3 --arrival-seed 0 $(SERVE_FLAGS)
+
+# Quick pass (wired into make check): a small controller-armed run at
+# a sustained rate — the controller must stay quiet (no spurious
+# degrade), the stream must drain, and the SLO verdict must hold.
+serve-control-quick:
+	$(PY) -m tpu_paxos serve --nodes 3 --values 60 --rate-milli 2000 \
+	  --slo-latency 16 --slo-budget-milli 150 --control \
+	  --rounds-per-window 4 --windows-per-dispatch 2 \
+	  --window-rounds 32 --max-rounds 4000
 
 # The debug.conf.sample workload end-to-end on the tpu engine.
 run:

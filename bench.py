@@ -33,9 +33,12 @@ TPU_PAXOS_BENCH_SIM_SHARDED_INSTANCES /
 TPU_PAXOS_BENCH_SHARDED_FAST_INSTANCES /
 TPU_PAXOS_BENCH_MEMBER_INSTANCES (secondary record sizes),
 TPU_PAXOS_BENCH_MEMBER=0 (skip the membership churn record),
-TPU_PAXOS_BENCH_SECONDARY=0 / TPU_PAXOS_BENCH_SHARDED_CHILD=0 (skip
-secondary records), TPU_PAXOS_BENCH_PROFILE=<dir> (jax profiler
-trace of the timed window).
+TPU_PAXOS_BENCH_SERVE_CONTROL=0 (skip the adaptive-serving spike A/B
+record; TPU_PAXOS_BENCH_SERVE_CONTROL_VALUES / _ARTIFACT size and
+artifact-path knobs), TPU_PAXOS_BENCH_SECONDARY=0 /
+TPU_PAXOS_BENCH_SHARDED_CHILD=0 (skip secondary records),
+TPU_PAXOS_BENCH_PROFILE=<dir> (jax profiler trace of the timed
+window).
 """
 
 from __future__ import annotations
@@ -45,6 +48,7 @@ import functools
 import json
 import os
 import sys
+import tempfile
 import time
 
 import jax
@@ -1326,6 +1330,203 @@ def bench_serve_fleet_record() -> dict:
     return record
 
 
+def _serve_control_record(ab, warm_compiles, config) -> dict:
+    """Record-or-error for the adaptive-serving spike A/B
+    (serve/control.spike_ab) — pure, so tests/test_bench_guards.py
+    drives it with synthetic A/B outputs.  Withhold conditions, each
+    fatal to the record:
+
+    - the OFF run must breach at all (a spike the uncontrolled
+      harness absorbs judges nothing);
+    - the ON run must name strictly FEWER breach windows than OFF at
+      the same offered trajectory, with at least one shed decision
+      actually taken (a controller that never acted proves nothing);
+    - zero sheds inside gray-region-attributed windows — shedding on
+      gray evidence is the cause-aware policy's one forbidden move;
+    - the decision-log replay (protocol decisions + control
+      decisions) must match the artifact sha256 byte-for-byte;
+    - ``warm_compiles``: the controller rides the serve envelope's
+      cached executable — any XLA compile during the measured A/B
+      (after the warm pass) withholds the record."""
+    off = ab.get("off", {})
+    on = ab.get("on", {})
+    raw = {
+        "breach_windows_off": off.get("breach_windows", []),
+        "breach_windows_on": on.get("breach_windows", []),
+        "sheds": int(ab.get("sheds", 0)),
+        "decisions": int(ab.get("decisions", 0)),
+    }
+
+    def _err(msg):
+        return {
+            "engine": "serve_control",
+            "error": msg,
+            **raw,
+            "config": config,
+        }
+
+    if warm_compiles:
+        return _err(
+            f"envelope-cache claim failed: {warm_compiles} warm XLA "
+            "compiles during the measured spike A/B — the controller "
+            "must ride the cached serve executable, record withheld"
+        )
+    if not off.get("breach_windows"):
+        return _err(
+            "controller-off run breached nowhere — the spike never "
+            "bit, so the A/B judges nothing; record withheld"
+        )
+    if ab.get("gray_shed_violations"):
+        return _err(
+            "controller shed inside gray-region-attributed windows "
+            f"{ab['gray_shed_violations']} — the cause-aware table's "
+            "never-shed-on-gray rule broke, record withheld"
+        )
+    if not ab.get("fewer_breach_windows"):
+        return _err(
+            "controller-on did not strictly reduce the breach-window "
+            f"list ({raw['breach_windows_off']} -> "
+            f"{raw['breach_windows_on']}); record withheld"
+        )
+    if raw["sheds"] < 1:
+        return _err(
+            "controller-on took zero shed decisions; the breach "
+            "reduction is not attributable to control, record withheld"
+        )
+    replay = ab.get("replay")
+    if replay is None or not replay.get("match"):
+        return _err(
+            "controlled-run artifact did not replay decision-log "
+            "sha256-identically; record withheld"
+        )
+    return {
+        "engine": "serve_control",
+        "metric": "serve_control_breach_rounds_off_vs_on",
+        "value": {
+            "off": int(ab["breach_rounds_off"]),
+            "on": int(ab["breach_rounds_on"]),
+        },
+        "unit": "breach-attributed rounds (virtual clock)",
+        **raw,
+        "gray_shed_violations": [],
+        "causes_on": on.get("causes", []),
+        "off": off,
+        "on": on,
+        "policy": ab.get("policy", {}),
+        "slo": ab.get("slo", {}),
+        "replay": {
+            "match": True,
+            "decision_log_sha256": replay.get("decision_log_sha256",
+                                              replay.get("sha256", "")),
+        },
+        "warm_compiles_measured": 0,
+        "config": config,
+    }
+
+
+# jax.monitoring has no listener-removal API (see the fleet-serving
+# census note above) — one module-level census, started per call.
+_serve_control_census = None
+
+
+def bench_serve_control_record() -> dict:
+    """Secondary record: ADAPTIVE SERVING (tpu_paxos/serve/control.py)
+    — THE judgment cell for the admission controller: one load spike
+    (4x the base Poisson rate over the middle half of the stream)
+    served twice at the same offered trajectory on a deliberately
+    admission-capped engine (``assign_window=8`` bounds concurrent
+    assignment, so the spike builds a real queue), controller off
+    then on.  The record is the breach-window comparison: ON must
+    name strictly fewer saturation-attributed breach windows, shed
+    only outside gray-region-attributed windows, replay its combined
+    decision log sha256-identically from the committed artifact
+    schema, and ride the envelope cache with zero warm compiles
+    across the measured A/B."""
+    from tpu_paxos.analysis import tracecount
+    from tpu_paxos.config import SimConfig
+    from tpu_paxos.serve import control as sctl
+    from tpu_paxos.serve import harness as sharness
+
+    n_values = int(
+        os.environ.get("TPU_PAXOS_BENCH_SERVE_CONTROL_VALUES", 1000)
+    )
+    # The judgment cell is a fixed marginal-overload shape, not a
+    # throughput sweep: base rate 2 values/round against ~2.5
+    # values/round of admission capacity (assign_window=8), spiked 4x
+    # over the middle half — overload the controller can actually
+    # mitigate by shedding the declared tier-2 third of the stream.
+    rate_milli = 2000
+    spike_factor = 4
+    r_window, s_dispatch, w_rounds = 4, 2, 32
+    seed = 0
+    cfg = SimConfig(
+        n_nodes=3,
+        n_instances=2048,
+        proposers=(0, 1),
+        seed=3,
+        max_rounds=8000,
+        assign_window=8,
+    )
+    slo = sharness.ServeSLO(latency_rounds=16, budget_milli=150)
+    art_path = os.environ.get(
+        "TPU_PAXOS_BENCH_SERVE_CONTROL_ARTIFACT",
+        os.path.join(tempfile.gettempdir(), "bench_serve_control.json"),
+    )
+
+    def _ab():
+        return sctl.spike_ab(
+            cfg, n_values, rate_milli,
+            slo=slo, seed=seed,
+            rounds_per_window=r_window,
+            windows_per_dispatch=s_dispatch,
+            spike_factor=spike_factor,
+            spike_start_frac=0.25,
+            spike_len_frac=0.5,
+            window_rounds=w_rounds,
+            artifact_path=art_path,
+        )
+
+    _ab()  # warm the envelope executable (off and on share it)
+    global _serve_control_census
+    if _serve_control_census is None:
+        _serve_control_census = tracecount.CompileCensus()
+    census = _serve_control_census.start()
+    before = sum(
+        census.engine_counts.get(k, 0)
+        for k in ("serve", "serve_control")
+    )
+    try:
+        ab = _ab()
+    finally:
+        warm_compiles = sum(
+            census.engine_counts.get(k, 0)
+            for k in ("serve", "serve_control")
+        ) - before
+        census.stop()
+    config = {
+        "n_nodes": cfg.n_nodes,
+        "n_instances": cfg.n_instances,
+        "assign_window": cfg.assign_window,
+        "n_values": n_values,
+        "rate_milli": rate_milli,
+        "spike_factor": spike_factor,
+        "spike_start_frac": 0.25,
+        "spike_len_frac": 0.5,
+        "rounds_per_window": r_window,
+        "windows_per_dispatch": s_dispatch,
+        "window_rounds": w_rounds,
+        "admit_width": ab["admit_width"],
+        "faults": "none (gray-region must stay quiet for the "
+                  "never-shed-on-gray clause to be a live check)",
+        "arrivals": "poisson + mid-run spike",
+        "slo": ab["slo"],
+        "latency_unit": "rounds (virtual clock)",
+        "devices": 1,
+        "platform": jax.devices()[0].platform,
+    }
+    return _serve_control_record(ab, warm_compiles, config)
+
+
 def _member_record(host_runs, dev_runs, state_bytes, config) -> dict:
     """Record-or-error for the membership host-vs-device timing pairs
     — pure, so tests/test_bench_guards.py drives it with synthetic
@@ -1800,6 +2001,13 @@ def main() -> None:
             except Exception as e:
                 secondary.append(
                     {"engine": "serve_fleet", "error": str(e)[:500]}
+                )
+        if os.environ.get("TPU_PAXOS_BENCH_SERVE_CONTROL", "1") == "1":
+            try:
+                secondary.append(bench_serve_control_record())
+            except Exception as e:
+                secondary.append(
+                    {"engine": "serve_control", "error": str(e)[:500]}
                 )
         if os.environ.get("TPU_PAXOS_BENCH_MEMBER", "1") == "1":
             try:

@@ -307,7 +307,10 @@ def test_telemetry_never_leaks_into_artifacts():
     assert set(ARTIFACT_SCHEMA.props) == {
         "format", "engine", "devices", "cfg", "workload", "gates",
         "chains", "extra_checks", "violation", "decision_log_sha256",
-        "rounds",
+        "rounds", "serve",
+        # "serve" (PR 16) is REPLAY INPUT — arrivals, priorities, the
+        # control policy, and the decision trail — not telemetry; the
+        # recorder's output stays recomputed at replay
     }, "artifact schema grew a field — telemetry must stay recomputed"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     wedge = os.path.join(repo, "stress-triage",

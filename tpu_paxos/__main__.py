@@ -496,10 +496,13 @@ def run_repro(argv) -> int:
     # malformed artifacts fall through — load_artifact produces the
     # clean exit-2 schema error below.
     devices = 1
+    engine = "sim"
     try:
         with open(args.artifact) as f:
             hdr = json.load(f)
-        if isinstance(hdr, dict) and hdr.get("engine") == "sharded":
+        if isinstance(hdr, dict):
+            engine = hdr.get("engine", "sim")
+        if engine == "sharded":
             devices = int(hdr.get("devices", 1))
     except (OSError, ValueError, TypeError):
         # TypeError: a non-numeric "devices" (null/list) — like the
@@ -511,8 +514,15 @@ def run_repro(argv) -> int:
         _select_backend(backend, mesh=devices)
     else:
         _select_backend(args.backend)
-    from tpu_paxos.harness import shrink as shr
     from tpu_paxos.utils import log as logm
+
+    if engine == "serve":
+        # controlled-serve artifacts replay through the admission
+        # controller (serve/control.reproduce): same schema surface,
+        # decision log extended with the control trail
+        from tpu_paxos.serve import control as shr
+    else:
+        from tpu_paxos.harness import shrink as shr
 
     logger = logm.get_logger("repro", _level(args))
     try:

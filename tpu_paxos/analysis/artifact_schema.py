@@ -233,12 +233,59 @@ _CFG = Obj({
     "faults": _FAULTS,
 }, extra_ok=False)
 
+# Controlled-serve replay block (serve/control.save_artifact).  CLOSED
+# like the engine-config structs: these dicts are splatted into
+# ControlPolicy / ServeSLO constructors on load.  The whole block is
+# OPTIONAL and absent from every classic sim/sharded artifact, so
+# existing artifacts stay byte-identical.
+_CONTROL_POLICY = Obj({
+    "n_tiers": Int(min=1),
+    "defer_tier": Int(min=1),
+    "shed_tier": Int(min=1),
+    "burn_low_milli": Int(min=0),
+    "patience": Int(min=1),
+    "ladder": ListOf(Int(min=1)),
+    "table": ListOf(Obj({
+        "cause_id": Int(min=0),
+        "action": OneOf("shed", "hold", "never"),
+    }, extra_ok=False)),
+}, extra_ok=False)
+
+_CONTROL_DECISION = Obj({
+    "dispatch": Int(min=1),
+    "action": OneOf("degrade", "hold", "restore"),
+    "level": Int(min=0),
+    "degraded": Bool(),
+    "cause_ids": ListOf(Int(min=0)),
+    "windows": ListOf(Int(min=0)),
+}, extra_ok=False)
+
+_SERVE_SLO = Obj({
+    "latency_rounds": Int(min=1),
+    "budget_milli": Int(min=1),
+    "burn_breach_milli": Int(min=0),
+}, extra_ok=False)
+
+_SERVE = Obj({
+    "arrivals": ListOf(ListOf(Int(min=0))),
+    "priorities": Nullable(ListOf(ListOf(Int(min=0)))),
+    "rounds_per_window": Int(min=1),
+    "windows_per_dispatch": Int(min=1),
+    "admit_width": Int(min=1),
+    "window_rounds": Int(min=1),
+    "slo": Nullable(_SERVE_SLO),
+    "control": Nullable(_CONTROL_POLICY),
+    "decisions": ListOf(_CONTROL_DECISION),
+}, extra_ok=False)
+
 ARTIFACT_SCHEMA = Obj({
     "format": Const(ARTIFACT_FORMAT),
     # replay engine selector (optional; absent = "sim").  "sharded"
     # artifacts also record the device count their decision log was
-    # produced at — placement, hence the log, depends on it.
-    "engine": OneOf("sim", "sharded"),
+    # produced at — placement, hence the log, depends on it.  "serve"
+    # artifacts replay through serve/control.reproduce and carry the
+    # "serve" block (arrivals/priorities/policy/decision trail).
+    "engine": OneOf("sim", "sharded", "serve"),
     "devices": Int(min=1),
     "cfg": _CFG,
     "workload": ListOf(ListOf(Int())),
@@ -248,6 +295,7 @@ ARTIFACT_SCHEMA = Obj({
     "violation": Str(),
     "decision_log_sha256": Sha256Hex(),
     "rounds": Int(min=0),
+    "serve": _SERVE,
 }, required=(
     "format", "cfg", "workload", "gates", "chains", "violation",
     "decision_log_sha256",
@@ -290,3 +338,20 @@ def validate_artifact(art) -> None:
             f"{len(art['gates'])} gate rows for "
             f"{len(art['workload'])} workload queues",
         )
+    # a serve artifact and its serve block imply each other, and the
+    # plan arrays must stay row-parallel with the workload streams
+    if (art.get("engine") == "serve") != ("serve" in art):
+        raise ArtifactSchemaError(
+            "serve",
+            "engine \"serve\" and the serve block imply each other",
+        )
+    if "serve" in art:
+        sv = art["serve"]
+        for key in ("arrivals", "priorities"):
+            rows = sv.get(key)
+            if rows is not None and len(rows) != len(art["workload"]):
+                raise ArtifactSchemaError(
+                    f"serve.{key}",
+                    f"{len(rows)} rows for "
+                    f"{len(art['workload'])} workload streams",
+                )

@@ -42,6 +42,7 @@ AUDIT_PROVIDERS = (
     "tpu_paxos.analysis.modelcheck",
     "tpu_paxos.serve.driver",
     "tpu_paxos.serve.fleet",
+    "tpu_paxos.serve.control",
 )
 
 

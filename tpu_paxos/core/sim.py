@@ -1997,11 +1997,22 @@ def build_engine(
     return round_fn
 
 
-def admit_block(st: SimState, admit: jax.Array) -> SimState:
+def admit_block(
+    st: SimState, admit: jax.Array, keep: jax.Array | None = None
+) -> SimState:
     """Open-loop admission: append one NONE-padded block of fresh vids
     per proposer at the queue tail (the serve harness's per-window
     upload; tpu_paxos/serve/driver.py runs this inside the donated
     dispatch window, between windows of rounds).
+
+    ``keep`` is the admit-block PRIORITY MASK (``[P, K]`` bool, or
+    None): the admission controller's shed path
+    (tpu_paxos/serve/control.py) uploads shed values IN the block
+    with ``keep=False`` so the device masks them to NONE before the
+    append — the shed happens on device, countable there, and the
+    block layout stays exactly the plan's.  ``keep=None`` (every
+    caller but the controller) traces the identical program as before
+    the mask existed — no branch, no extra ops.
 
     ``admit`` is ``[P, K]`` int32 with each row a value PREFIX padded
     by ``val.NONE``.  Slots at and past tail are invariantly NONE
@@ -2021,6 +2032,18 @@ def admit_block(st: SimState, admit: jax.Array) -> SimState:
     all-NONE), and admission happens BETWEEN dispatch windows, so it
     never races the in-round conflict requeue that also appends at
     tail."""
+    if keep is not None:
+        # shed-mask path: kept values must stay a NONE-padded PREFIX
+        # (a masked hole mid-row would put NONE below the new tail —
+        # a dead slot inside the live ring), so a stable argsort
+        # compacts survivors to the front in plan order
+        kept = keep & (admit != val.NONE)
+        order = jnp.argsort(jnp.logical_not(kept), axis=1, stable=True)
+        admit = jnp.where(
+            jnp.take_along_axis(kept, order, axis=1),
+            jnp.take_along_axis(admit, order, axis=1),
+            val.NONE,
+        )
     pr = st.prop
     k = admit.shape[1]
     width = pr.pend.shape[-1]

@@ -246,6 +246,52 @@ def serve_fleet_for(
     return runner
 
 
+def serve_control_for(
+    cfg: SimConfig,
+    queue_cap: int,
+    vid_bound: int,
+    rounds_per_window: int,
+    *,
+    window_rounds: int,
+    mesh=None,
+):
+    """The shared compiled CONTROLLED fleet-serving runner for this
+    envelope (``serve/control.ControlFleetRunner``) — the adaptive-
+    admission twin of :func:`serve_fleet_for`, in the same shared
+    cache under its own engine tag (the keep-mask program is a
+    different traced function).  A controlled (lanes x rates) sweep
+    then shares ONE executable per call shape: policies, priority
+    tiers, and SLO thresholds are runtime data, so arming the
+    controller costs dispatches, not compiles."""
+    import importlib
+
+    sctl = importlib.import_module("tpu_paxos.serve.control")
+
+    if cfg.faults.schedule is not None:
+        # checked HERE like serve_fleet_for: the key ignores the
+        # schedule, so a schedule-bearing cfg would otherwise HIT a
+        # warm cache and silently drop its correlated faults
+        raise ValueError(
+            "serve engines take no fault schedule (correlated-fault "
+            "serving rides the stress fleet envelope, not this driver)"
+        )
+    key = (
+        "serve_control",
+        *serve_envelope_key(
+            cfg, queue_cap, vid_bound, rounds_per_window,
+            window_rounds, mesh,
+        )[1:],
+    )
+    runner = _CACHE.get(key)
+    if runner is None:
+        runner = sctl.ControlFleetRunner(
+            cfg, queue_cap, vid_bound, rounds_per_window,
+            window_rounds, mesh=mesh,
+        )
+        _CACHE[key] = runner
+    return runner
+
+
 def member_envelope_key(
     n_nodes: int,
     n_instances: int,

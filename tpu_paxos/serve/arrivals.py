@@ -150,6 +150,52 @@ def diurnal_rounds(
     return np.floor(hi).astype(np.int32)
 
 
+def spike_rounds(
+    n_values: int, rate_milli: int, seed: int,
+    factor: int = 8, start_frac: float = 0.375, len_frac: float = 0.25,
+) -> np.ndarray:
+    """Load-spike arrivals: a Poisson process at ``rate_milli`` whose
+    rate multiplies by ``factor`` over one contiguous mid-run span —
+    the flash-crowd shape the admission controller
+    (serve/control.py) is judged against.  The spike spans
+    ``[start_frac, start_frac + len_frac)`` of the BASE-rate horizon
+    (``1000 * n_values / rate_milli`` rounds), so the same fractions
+    mean the same story at every rate.  Sampled exactly by inverting
+    the piecewise-linear cumulative rate in closed form (no thinning
+    — the draw count is deterministic).  Deterministic per
+    (n_values, rate_milli, seed, factor, start_frac, len_frac)."""
+    if rate_milli <= 0:
+        raise ValueError(
+            f"rate_milli must be positive (got {rate_milli}); use "
+            "immediate_rounds() for the offered-load-∞ limit"
+        )
+    if factor < 1:
+        raise ValueError(f"factor must be >= 1 (got {factor})")
+    if not (0.0 <= start_frac and 0.0 < len_frac):
+        raise ValueError("spike span fractions must be positive")
+    rng = np.random.default_rng((0x5350494B, int(seed)))
+    base = rate_milli / 1000.0  # values per round
+    if int(n_values) == 0:
+        return np.zeros((0,), np.int32)
+    horizon = n_values / base
+    t0, t1 = start_frac * horizon, (start_frac + len_frac) * horizon
+    unit = np.cumsum(rng.exponential(1.0, size=int(n_values)))
+    # cumulative rate: base*t before t0; slope base*factor inside
+    # [t0, t1); base again after — invert piecewise
+    u0 = base * t0
+    u1 = u0 + base * factor * (t1 - t0)
+    t = np.where(
+        unit <= u0,
+        unit / base,
+        np.where(
+            unit <= u1,
+            t0 + (unit - u0) / (base * factor),
+            t1 + (unit - u1) / base,
+        ),
+    )
+    return np.floor(t).astype(np.int32)
+
+
 #: Name -> builder map for the CLI's --arrivals flag (every builder
 #: shares the (n_values, rate_milli, seed) signature; extra shape
 #: knobs keep their defaults there).
@@ -158,7 +204,19 @@ ARRIVAL_BUILDERS = {
     "pareto": pareto_rounds,
     "bursty": bursty_rounds,
     "diurnal": diurnal_rounds,
+    "spike": spike_rounds,
 }
+
+
+def tier_priorities(vids, n_tiers: int = 3) -> np.ndarray:
+    """A declared per-value priority column: tier ``vid % n_tiers``
+    (0 = most important, higher tiers shed/defer first under the
+    admission controller's degradation).  Deterministic and
+    value-derived so replays reconstruct it from the artifact; real
+    deployments would declare tiers per request class the same way."""
+    if n_tiers < 1:
+        raise ValueError(f"n_tiers must be >= 1 (got {n_tiers})")
+    return (np.asarray(vids, np.int64) % int(n_tiers)).astype(np.int32)
 
 
 def immediate_rounds(n_values: int) -> np.ndarray:
@@ -202,9 +260,18 @@ class ArrivalPlan:
     admitted (a value arriving strictly inside a window waits for the
     next boundary; one arriving at the boundary makes the upload).
     Every block is a NONE-padded value prefix per proposer row, ready
-    for :func:`tpu_paxos.core.sim.admit_block`."""
+    for :func:`tpu_paxos.core.sim.admit_block`.
 
-    def __init__(self, streams, arrs, rounds_per_window: int):
+    ``prios`` is the optional PRIORITY COLUMN (one int tier per value,
+    parallel to ``streams``; 0 = most important): the plain plan
+    ignores it for admission — window quantization treats every tier
+    alike — but carries it per block (:meth:`prio_block`) so the
+    admission controller (serve/control.py) can shed or defer at
+    declared tiers while deferred values keep their TRUE arrival
+    rounds from this plan's ``arrs`` (they charge their real
+    queue-wait through the ingest stamps)."""
+
+    def __init__(self, streams, arrs, rounds_per_window: int, prios=None):
         if len(streams) != len(arrs):
             raise ValueError("one arrival array per proposer stream")
         self.streams = [np.asarray(s, np.int32).reshape(-1) for s in streams]
@@ -212,6 +279,17 @@ class ArrivalPlan:
         for s, a in zip(self.streams, self.arrs):
             if s.shape != a.shape:
                 raise ValueError("one arrival round per stream value")
+        if prios is None:
+            self.prios = None
+        else:
+            if len(prios) != len(self.streams):
+                raise ValueError("one priority array per proposer stream")
+            self.prios = [np.asarray(p, np.int32).reshape(-1) for p in prios]
+            for s, p in zip(self.streams, self.prios):
+                if s.shape != p.shape:
+                    raise ValueError("one priority tier per stream value")
+                if p.size and int(p.min()) < 0:
+                    raise ValueError("priority tiers must be nonnegative")
         if rounds_per_window <= 0:
             raise ValueError("rounds_per_window must be positive")
         self.rounds_per_window = int(rounds_per_window)
@@ -274,3 +352,18 @@ class ArrivalPlan:
             admit[pi, :n] = self.streams[pi][lo:hi]
             arr[pi, :n] = self.arrs[pi][lo:hi]
         return admit, arr
+
+    def prio_block(self, j: int, admit_width: int) -> np.ndarray:
+        """Window ``j``'s priority tiers, ``[P, K]`` int32 aligned
+        with :meth:`block`'s layout (0 in padding slots).  Requires a
+        declared priority column."""
+        if self.prios is None:
+            raise ValueError("this plan declares no priority column")
+        p = len(self.streams)
+        out = np.zeros((p, admit_width), np.int32)
+        if j >= self.n_windows:
+            return out
+        for pi in range(p):
+            lo, hi = int(self._cuts[pi][j]), int(self._cuts[pi][j + 1])
+            out[pi, :hi - lo] = self.prios[pi][lo:hi]
+        return out

@@ -712,6 +712,7 @@ def sweep_fleet_load(
     region_names: tuple = (),
     mesh=None,
     arrivals: str = "poisson",
+    control=None,
 ) -> dict:
     """The headline SURFACE: aggregate sustained values/sec and the
     saturation knee over (lane count x offered rate).  One cell = one
@@ -720,9 +721,24 @@ def sweep_fleet_load(
     envelope's one cached executable (admit width is the max over the
     whole grid, so the call shape never varies within a lane count),
     and the knee per lane count is ``harness.judge_knee`` over that
-    row — a knee SURFACE, not a knee point."""
+    row — a knee SURFACE, not a knee point.
+
+    ``control`` (a ``serve/control.ControlPolicy``; requires ``slo``)
+    arms the per-lane admission controller in EVERY cell — points
+    then carry their shed/decision ledgers and the exit verdict must
+    go through :func:`sweep_verdict`, which refuses a floor-rate cell
+    that only drained by shedding."""
     lane_counts = [int(x) for x in lane_counts]
     rates = sorted(int(x) for x in rates_milli)
+    if control is not None:
+        # lazy: the controller module is jax-bearing and only the
+        # controlled sweep pays its import (DET-closure discipline)
+        from tpu_paxos.serve import control as ctlm
+
+        if slo is None:
+            raise ValueError(
+                "a controlled sweep reads SLO verdicts; declare an slo"
+            )
     # an explicit admit_width is AUTHORITATIVE (the caller computed it
     # via grid_admit_width and may have warmed executables at exactly
     # that shape — recomputing here would duplicate the whole grid's
@@ -740,19 +756,38 @@ def sweep_fleet_load(
     for lc in lane_counts:
         points = []
         for rm in rates:
-            rep = serve_fleet_run(
-                cfg,
-                fleet_lanes(cfg, lc, n_values, rm, seed, arrivals),
-                rounds_per_window=rounds_per_window,
-                windows_per_dispatch=windows_per_dispatch,
-                admit_width=width,
-                window_rounds=window_rounds,
-                slo=slo,
-                region_map=region_map,
-                region_names=region_names,
-                mesh=mesh,
-            )
-            points.append(_fleet_point(rm, rep))
+            lanes = fleet_lanes(cfg, lc, n_values, rm, seed, arrivals)
+            if control is not None:
+                rep = ctlm.controlled_fleet_run(
+                    cfg, lanes,
+                    control=control,
+                    rounds_per_window=rounds_per_window,
+                    windows_per_dispatch=windows_per_dispatch,
+                    admit_width=width,
+                    window_rounds=window_rounds,
+                    slo=slo,
+                    region_map=region_map,
+                    region_names=region_names,
+                    mesh=mesh,
+                )
+            else:
+                rep = serve_fleet_run(
+                    cfg, lanes,
+                    rounds_per_window=rounds_per_window,
+                    windows_per_dispatch=windows_per_dispatch,
+                    admit_width=width,
+                    window_rounds=window_rounds,
+                    slo=slo,
+                    region_map=region_map,
+                    region_names=region_names,
+                    mesh=mesh,
+                )
+            pt = _fleet_point(rm, rep)
+            if control is not None:
+                pt["shed"] = rep.shed_total
+                pt["lane_shed"] = rep.lane_shed
+                pt["control_decisions"] = len(rep.decisions)
+            points.append(pt)
         knee = sh.judge_knee(points, knee_factor)
         cells[str(lc)] = {"points": points, "knee": knee}
         knee_surface.append({"lanes": lc, **knee})
@@ -771,7 +806,48 @@ def sweep_fleet_load(
         "values_per_sec_surface": surface,
         "cells": cells,
         "knee_surface": knee_surface,
+        **({
+            "control": ctlm.policy_to_dict(control)
+        } if control is not None else {}),
     }
+
+
+def sweep_verdict(summary: dict) -> bool:
+    """The sweep's exit verdict: every lane count's FLOOR-rate cell
+    must drain (the every-lane-count rule — a fleet that saturates at
+    the floor rate is broken no matter how the single-lane row looks).
+
+    Controller-armed sweeps (``summary["control"]``) are judged
+    HARDER at the floor, not softer: the floor cell must drain with
+    ZERO sheds and no host-confirmed floor breach — a controller that
+    sheds its way to zero backlog at the floor rate is masking
+    saturation, and this verdict is what keeps it from exiting 0.
+    Higher-rate cells of a controlled sweep are exploratory (the
+    knee hunt EXPECTS breaches there, mitigated); uncontrolled
+    sweeps keep the old rule — any host-confirmed breach reds the
+    whole surface."""
+    cells = summary.get("cells", {})
+    if not cells:
+        return False
+    controlled = "control" in summary
+    for c in cells.values():
+        floor = c["points"][0]
+        if not floor["sustained"]:
+            return False
+        if controlled:
+            if floor.get("shed", 0):
+                return False
+            if floor.get("slo") and not all(
+                v["ok"] for v in floor["slo"].values()
+            ):
+                return False
+        else:
+            for pt in c["points"]:
+                if pt.get("slo") and not all(
+                    v["ok"] for v in pt["slo"].values()
+                ):
+                    return False
+    return True
 
 
 # ---------------- CLI ----------------
@@ -812,6 +888,14 @@ def main(argv=None) -> int:
                     help="latency SLO in rounds; arms the on-device "
                     "per-lane burn-rate verdict (0 = no SLO)")
     ap.add_argument("--slo-budget-milli", type=int, default=100)
+    ap.add_argument("--control", action="store_true",
+                    help="arm the per-lane admission controller "
+                    "(serve/control.py) in every cell; requires "
+                    "--slo-latency.  The sweep verdict then refuses "
+                    "a floor-rate cell that only drained by shedding")
+    ap.add_argument("--priority-tiers", type=int, default=3,
+                    help="declared per-value priority tiers for "
+                    "--control (tier 0 = always admit)")
     ap.add_argument("--instances", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-rounds", type=int, default=20_000)
@@ -853,6 +937,20 @@ def main(argv=None) -> int:
                     budget_milli=args.slo_budget_milli)
         if args.slo_latency else None
     )
+    policy = None
+    if args.control:
+        from tpu_paxos.serve import control as ctlm
+
+        if slo is None:
+            raise SystemExit(
+                "--control reads SLO verdicts; declare --slo-latency"
+            )
+        n_tiers = args.priority_tiers
+        policy = ctlm.ControlPolicy(
+            n_tiers=n_tiers,
+            defer_tier=max(n_tiers - 1, 1),
+            shed_tier=max(n_tiers - 1, 1),
+        )
     if args.sweep or args.lane_counts:
         rates = (
             [int(x) for x in args.sweep.split(",") if x.strip()]
@@ -871,33 +969,36 @@ def main(argv=None) -> int:
             slo=slo,
             mesh=mesh,
             arrivals=args.arrivals,
+            control=policy,
         )
         # every lane count's LOWEST-rate cell must drain (a fleet
         # that saturates even at the floor rate is broken regardless
-        # of how the single-lane row looks); breaches confirmed by
-        # the host judge red the sweep too
-        summary["ok"] = bool(
-            all(
-                c["points"][0]["sustained"]
-                for c in summary["cells"].values()
-            )
-            and all(
-                not pt.get("slo")
-                or all(v["ok"] for v in pt["slo"].values())
-                for c in summary["cells"].values() for pt in c["points"]
-            )
-        )
+        # of how the single-lane row looks); a controller-armed cell
+        # must additionally drain WITHOUT shedding at the floor —
+        # sweep_verdict() is the one exit gate for both shapes
+        summary["ok"] = sweep_verdict(summary)
     else:
-        rep = serve_fleet_run(
-            cfg,
-            fleet_lanes(cfg, args.lanes, args.values, args.rate_milli,
-                        args.seed, args.arrivals),
-            rounds_per_window=args.rounds_per_window,
-            windows_per_dispatch=args.windows_per_dispatch,
-            window_rounds=w_rounds,
-            slo=slo,
-            mesh=mesh,
-        )
+        lanes = fleet_lanes(cfg, args.lanes, args.values,
+                            args.rate_milli, args.seed, args.arrivals)
+        if policy is not None:
+            rep = ctlm.controlled_fleet_run(
+                cfg, lanes,
+                control=policy,
+                rounds_per_window=args.rounds_per_window,
+                windows_per_dispatch=args.windows_per_dispatch,
+                window_rounds=w_rounds,
+                slo=slo,
+                mesh=mesh,
+            )
+        else:
+            rep = serve_fleet_run(
+                cfg, lanes,
+                rounds_per_window=args.rounds_per_window,
+                windows_per_dispatch=args.windows_per_dispatch,
+                window_rounds=w_rounds,
+                slo=slo,
+                mesh=mesh,
+            )
         summary = {
             "metric": "serve_fleet",
             "arrivals": args.arrivals,
@@ -911,6 +1012,10 @@ def main(argv=None) -> int:
                      or all(v["ok"] for v in rep.slo.values()))
             ),
         }
+        if policy is not None:
+            summary["shed"] = rep.shed_total
+            summary["lane_shed"] = rep.lane_shed
+            summary["control_decisions"] = len(rep.decisions)
     print(json.dumps(summary, sort_keys=True))
     return 0 if summary["ok"] else 1
 

@@ -51,6 +51,25 @@ from tpu_paxos.telemetry import recorder as telem
 #: Cause names, in canonical (tie-break) order.
 CAUSES = ("duel-churn", "gray-region", "partition", "saturation")
 
+#: Stable integer cause codes, next to the string labels: the
+#: admission controller's policy table (serve/control.py) and serve
+#: verdicts key on CODES, so renaming or reordering a label can never
+#: silently rewire a shed/hold policy.  0 is reserved for "unknown";
+#: 1..N follow :data:`CAUSES` canonical order.  The mapping is part of
+#: the pinned determinism surface (tests/test_control.py) — appending
+#: a new cause gets the next free code; existing codes never move.
+CAUSE_IDS = {"unknown": 0, **{c: i + 1 for i, c in enumerate(CAUSES)}}
+
+#: Code -> name, for rendering decisions back into reports.
+CAUSE_NAMES = {v: k for k, v in CAUSE_IDS.items()}
+
+
+def cause_code(name: str) -> int:
+    """The stable integer code for a cause label (0 for any label the
+    table does not know — unknown causes must never match a policy
+    row by accident)."""
+    return CAUSE_IDS.get(name, 0)
+
 # ---- signal thresholds (integer/fixed-point; part of the pinned
 # ---- determinism surface — change them only with the fixtures) ----
 
@@ -391,7 +410,14 @@ def diagnose_breaches(
         for w in breach_windows
     ]
     causes = sorted({v["cause"] for v in windows})
-    return {"windows": windows, "causes": causes}
+    # codes alongside the strings: verdict consumers (the admission
+    # controller, the serve bench) key on these; strings stay for
+    # human-facing reports
+    return {
+        "windows": windows,
+        "causes": causes,
+        "cause_ids": sorted(cause_code(c) for c in causes),
+    }
 
 
 def label_windows(
