@@ -134,8 +134,13 @@ fleet-quick:
 mc:
 	$(PY) -m tpu_paxos mc --scope full --triage-dir stress-triage
 
+# All four committed scopes in ONE process: gray shares quick's
+# engine envelope so its chunks ride quick's compile; churn and
+# control certify the membership fleet and the admission controller's
+# policy contracts (~60s cold on cpu, dominated by the three engine
+# compiles).
 mc-quick:
-	$(PY) -m tpu_paxos mc --scope quick --triage-dir stress-triage
+	$(PY) -m tpu_paxos mc --scope quick,gray,churn,control --triage-dir stress-triage
 
 # Open-loop serving (tpu_paxos/serve/): Poisson arrivals at an
 # offered rate (values per 1000 rounds) admitted mid-flight through

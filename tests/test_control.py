@@ -97,7 +97,9 @@ def test_cause_ids_pinned_exactly():
         "saturation": 4,
     }
     assert dg.CAUSE_NAMES[4] == "saturation"
+    # paxlint: allow[CTL001] this test pins the wire encoding itself
     assert dg.cause_code("gray-region") == 2
+    # paxlint: allow[CTL001] this test pins the wire encoding itself
     assert dg.cause_code("never-heard-of-it") == 0
 
 

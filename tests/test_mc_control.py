@@ -1,0 +1,365 @@
+"""mc controller scope (PR 17): exhaustive model checking of the
+admission controller's policy invariants (``analysis/mc_control.py``).
+
+Contracts: the length-stratified sequence codec and the (policy x
+sequence | e2e cell) scenario codec are bijections; the host-plane
+oracle (``judge_sequence`` — predicted-state reconstruction, not a
+re-run of ``decide``'s code) certifies the clean policy grid and
+provably catches the seeded shed-on-gray wedge
+(``TPU_PAXOS_SEEDED_WEDGE=shed-on-gray``); counterexamples shrink
+greedily and land as byte-replaying ``mc-control`` artifacts that
+replay WITHOUT the wedge env var (the artifact carries the wedged
+policy).
+
+The committed scope's e2e device cells are slow-marked (one
+controlled-serve compile); their fast-tier coverage is the host-only
+``run_scope`` tests here (same judging path, zero device work) plus
+tests/test_control.py's controlled-serve pins on the same geometry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_paxos.analysis import mc_control as mcc
+from tpu_paxos.analysis import modelcheck as mc
+from tpu_paxos.analysis.artifact_schema import ArtifactSchemaError
+from tpu_paxos.serve import control as ctl
+from tpu_paxos.telemetry import diagnose as diag
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = {
+    "tier_bands": [[3, 1, 2]], "patiences": [1], "ladders": [[]],
+    "window_sets": [[], ["gray-region"], ["saturation"]],
+    "burn_tiers": [0, 900], "max_dispatches": 2, "plan_values": 4,
+    "chunk_lanes": 16,
+}
+
+
+def _committed():
+    return mc.load_scopes()["control"]
+
+
+def _tiny_host_scope(**over):
+    return mcc.ControlScope.from_dict(dict(TINY, **over))
+
+
+# ---------------- scope parse / validate ----------------
+
+def test_committed_control_scope_loads_and_registers():
+    scope = _committed()
+    assert mc.scope_type(scope) == "control"
+    enum = mc.enum_for(scope)
+    assert isinstance(enum, mcc.ControlEnum)
+    # full == reduced: no node group to quotient by
+    assert enum.reduced == list(range(enum.total))
+    assert enum.total == enum.host_total + enum.n_e2e
+
+
+def test_validator_named_rules():
+    with pytest.raises(mc.ScopeError, match="defer"):
+        _tiny_host_scope(tier_bands=[[3, 2, 1]])
+    with pytest.raises(mc.ScopeError, match="unknown cause name"):
+        _tiny_host_scope(window_sets=[["not-a-cause"]])
+    with pytest.raises(mc.ScopeError, match="ascend"):
+        _tiny_host_scope(ladders=[[2, 1]])
+    with pytest.raises(
+        mc.ScopeError, match=rf"\[1, {mcc.MAX_CTL_DISPATCHES}\]"
+    ):
+        _tiny_host_scope(max_dispatches=mcc.MAX_CTL_DISPATCHES + 1)
+    with pytest.raises(mc.ScopeError, match="come together"):
+        _tiny_host_scope(e2e_policies=[0])
+    with pytest.raises(mc.ScopeError, match="outside the policy grid"):
+        _tiny_host_scope(e2e_policies=[1], e2e_arrival_seeds=[0])
+    with pytest.raises(mc.ScopeError, match="unknown scope field"):
+        _tiny_host_scope(n_nodes=3)
+
+
+# ---------------- codec ----------------
+
+def test_sequence_codec_inverse_exhaustive():
+    """rank -> sequence -> rank is the identity over EVERY bounded
+    sequence of the committed scope, lengths stratified correctly."""
+    enum = mcc.ControlEnum(_committed())
+    for r in range(enum.n_seq):
+        seq = enum.seq_unrank(r)
+        assert 1 <= len(seq) <= enum.scope.max_dispatches
+        assert all(0 <= d < enum.n_letters for d in seq)
+        assert enum.seq_rank(seq) == r
+
+
+def test_scenario_codec_boundaries_and_e2e_tail():
+    """decode/encode at both ends of the host plane and across the
+    e2e tail boundary — the cells the mixed codec must not shear."""
+    enum = mcc.ControlEnum(_committed())
+    for i in (0, enum.host_total - 1, enum.host_total, enum.total - 1):
+        sc = enum.decode(i)
+        assert enum.encode(sc) == i
+        assert (sc.seq is None) == (i >= enum.host_total)
+    tail = enum.decode(enum.host_total)
+    assert tail.e2e_seed == int(enum.scope.e2e_arrival_seeds[0])
+    assert tail.policy == int(enum.scope.e2e_policies[0])
+    with pytest.raises(IndexError):
+        enum.decode(enum.total)
+
+
+def test_policy_grid_shape_and_order():
+    scope = _committed()
+    pols = mcc.policy_grid(scope)
+    assert len(pols) == (
+        len(scope.tier_bands) * len(scope.patiences) * len(scope.ladders)
+    )
+    # band-major, then patience, then ladder — the codec's documented
+    # enumeration order
+    p0 = pols[0]
+    assert (p0.n_tiers, p0.defer_tier, p0.shed_tier) == scope.tier_bands[0]
+    assert p0.patience == scope.patiences[0]
+
+
+# ---------------- the host oracle ----------------
+
+def test_clean_policy_grid_certifies_over_all_letters():
+    """Every committed policy passes every single-letter dispatch —
+    the oracle's baseline (the full sweep is the committed
+    certificate's job)."""
+    scope = _committed()
+    enum = mcc.ControlEnum(scope)
+    for pi in range(enum.n_policies):
+        for letter in enum.letters:
+            _, bits = mcc.judge_sequence(
+                enum.policies[pi], [letter], scope.plan_values
+            )
+            assert all(bits.values()), (pi, letter, bits)
+
+
+def test_gray_veto_catches_wedged_policy():
+    """The seeded policy bug: gray-region forced to shed fails the
+    veto invariant on every gray-naming window, including gray beside
+    saturation."""
+    scope = _committed()
+    enum = mcc.ControlEnum(scope)
+    wedged = ctl.wedged_policy(enum.policies[0])
+    for names in (("gray-region",), ("gray-region", "saturation")):
+        _, bits = mcc.judge_sequence(
+            wedged, [(names, 900)], scope.plan_values
+        )
+        assert not bits["veto"]
+        assert mcc.violation_of(bits) == "ctl-gray-veto"
+    # a pure saturation window sheds without degrading granularity
+    # under the wedge too — not a veto matter
+    _, bits = mcc.judge_sequence(
+        wedged, [(("saturation",), 900)], scope.plan_values
+    )
+    assert bits["veto"]
+
+
+def test_wedge_env_arms_policy_materialization(monkeypatch):
+    enum = mcc.ControlEnum(_committed())
+    gray = diag.CAUSE_IDS["gray-region"]
+    assert dict(enum.policy_of(0).table).get(gray) != "shed"
+    monkeypatch.setenv(
+        "TPU_PAXOS_SEEDED_WEDGE", ctl.WEDGE_SHED_ON_GRAY
+    )
+    assert dict(enum.policy_of(0).table)[gray] == "shed"
+
+
+def test_trail_legality_rejects_bad_trails():
+    # the committed grid's second policy carries the real ladder
+    # (1, 2) — a single-rung ladder would make "degrade stays at the
+    # same level" vacuously legal
+    p = mcc.policy_grid(_committed())[1]
+    top = p.top_level
+    assert top > 0
+    assert mcc._trail_legal(p, [])
+    # degrade must land exactly one rung down
+    assert not mcc._trail_legal(
+        p, [{"action": "degrade", "level": top, "degraded": True}]
+    )
+    # restore without anything to restore
+    assert not mcc._trail_legal(
+        p, [{"action": "restore", "level": top, "degraded": False}]
+    )
+    # unknown action
+    assert not mcc._trail_legal(
+        p, [{"action": "panic", "level": top, "degraded": False}]
+    )
+    # legal degrade -> restore round trip
+    assert mcc._trail_legal(p, [
+        {"action": "degrade", "level": top - 1, "degraded": True},
+        {"action": "restore", "level": top, "degraded": False},
+    ])
+
+
+def test_admission_exact_over_degraded_timelines():
+    for p in mcc.policy_grid(_committed()):
+        assert mcc._admission_exact(p, [True, False, True, True], 6)
+
+
+def test_shrink_reaches_a_single_dispatch():
+    scope = _committed()
+    enum = mcc.ControlEnum(scope)
+    wedged = ctl.wedged_policy(enum.policies[0])
+    gray_li = next(
+        li for li, (ws, b) in enumerate(enum.letters)
+        if "gray-region" in ws and b > 0
+    )
+    quiet_li = next(
+        li for li, (ws, _) in enumerate(enum.letters) if not ws
+    )
+    small = mcc.shrink_sequence(
+        wedged, enum.letters, (quiet_li, gray_li, quiet_li),
+        scope.plan_values,
+    )
+    assert small == (gray_li,)
+
+
+# ---------------- artifact replay ----------------
+
+def _artifact(tmp_path, monkeypatch=None):
+    scope = _committed()
+    enum = mcc.ControlEnum(scope)
+    wedged = ctl.wedged_policy(enum.policies[0])
+    letters = [(("gray-region",), 900)]
+    decisions, bits = mcc.judge_sequence(
+        wedged, letters, scope.plan_values
+    )
+    path = str(tmp_path / "mc_ctl_scenario_0.json")
+    mcc.save_ctl_artifact(
+        path, scope, wedged, letters,
+        mcc.violation_of(bits), decisions,
+    )
+    return path
+
+
+def test_artifact_replays_byte_identically(tmp_path, monkeypatch):
+    """The artifact carries the wedged policy, so replay is exact and
+    wedge-env independent."""
+    path = _artifact(tmp_path)
+    monkeypatch.delenv("TPU_PAXOS_SEEDED_WEDGE", raising=False)
+    rep = mcc.reproduce(path)
+    assert rep["match"] and rep["decisions_match"]
+    assert rep["violation"] == rep["recorded_violation"] == "ctl-gray-veto"
+    assert rep["decision_log_sha256"] == rep["recorded_sha256"]
+    assert "[ctl 1] degrade" in rep["decision_log"]
+
+
+def test_artifact_tamper_and_schema_errors(tmp_path):
+    path = _artifact(tmp_path)
+    with open(path) as f:
+        art = json.load(f)
+    # tampered trail: replay must refuse the match
+    art["decisions"] = []
+    art["violation"] = "none"
+    with open(path, "w") as f:
+        json.dump(art, f)
+    rep = mcc.reproduce(path)
+    assert not rep["match"] and not rep["decisions_match"]
+    # missing field and wrong engine are schema errors, named
+    bad = dict(art)
+    del bad["control_log_sha256"]
+    with open(path, "w") as f:
+        json.dump(bad, f)
+    with pytest.raises(ArtifactSchemaError, match="control_log_sha256"):
+        mcc.reproduce(path)
+    with open(path, "w") as f:
+        json.dump(dict(art, engine="serve"), f)
+    with pytest.raises(ArtifactSchemaError, match="mc-control"):
+        mcc.reproduce(path)
+
+
+# ---------------- host-plane run_scope ----------------
+
+def test_run_scope_host_only_certifies_clean(tmp_path):
+    """A host-only scope (no e2e cells) runs without any device work:
+    every nibble f, zero compiles in every chunk, summary shaped for
+    the shared certificate machinery."""
+    scope = _tiny_host_scope()
+    summary = mcc.run_scope(
+        scope, triage_dir=str(tmp_path), verbose=False
+    )
+    assert summary["ok"]
+    assert set(summary["verdict_bits"]) == {"f"}
+    assert summary["scenarios_full"] == summary["scenarios_reduced"]
+    assert all(c == 0 for c in summary["compiles_per_chunk"])
+    assert summary["seeded_wedge"] == ""
+    assert not os.listdir(tmp_path)
+
+
+def test_run_scope_finds_and_shrinks_the_seeded_wedge(
+    tmp_path, monkeypatch
+):
+    """THE recall pin: with the wedge armed, every gray-naming host
+    scenario fails the veto, the first counterexamples shrink to one
+    dispatch, and the dumped artifact replays with the env var
+    UNSET."""
+    monkeypatch.setenv(
+        "TPU_PAXOS_SEEDED_WEDGE", ctl.WEDGE_SHED_ON_GRAY
+    )
+    scope = _tiny_host_scope()
+    summary = mcc.run_scope(
+        scope, triage_dir=str(tmp_path), verbose=False,
+        max_counterexamples=3,
+    )
+    assert not summary["ok"]
+    assert summary["seeded_wedge"] == ctl.WEDGE_SHED_ON_GRAY
+    cx = summary["counterexamples"][0]
+    assert cx["violation"] == "ctl-gray-veto"
+    assert cx["shrunk_dispatches"] == 1
+    assert os.path.basename(cx["artifact"]).startswith(
+        "mc_ctl_scenario_"
+    )
+    monkeypatch.delenv("TPU_PAXOS_SEEDED_WEDGE")
+    rep = mcc.reproduce(cx["artifact"])
+    assert rep["match"]
+    assert rep["violation"] == "ctl-gray-veto"
+
+
+# ---------------- committed scope + e2e cells (slow tier) -----------
+
+@pytest.mark.slow
+def test_control_scope_certifies_committed_with_e2e():
+    """Slow tier: the committed control scope end-to-end, e2e device
+    cells included — verdict nibbles match the pinned certificate and
+    only the first chunk (the first e2e cell) compiles.  Fast-tier
+    coverage: the host-only run_scope tests above + test_control.py's
+    controlled-serve pins."""
+    scope = _committed()
+    summary = mcc.run_scope(scope, verbose=False)
+    cert = mc.load_certificates()["control"]
+    assert summary["ok"], summary["counterexamples"][:2]
+    assert summary["verdict_bits_sha256"] == cert["verdict_bits_sha256"]
+    assert summary["e2e_cells"] == 2
+    assert all(c == 0 for c in summary["compiles_per_chunk"][1:]), (
+        summary["compiles_per_chunk"]
+    )
+
+
+@pytest.mark.slow
+def test_cli_repro_routes_mc_control_artifacts(tmp_path):
+    """Slow tier (cold subprocess): ``python -m tpu_paxos repro``
+    routes engine=mc-control through analysis/mc_control.reproduce
+    and exits 0 on a byte-exact replay.  Fast-tier coverage: the
+    in-process reproduce() roundtrip above."""
+    path = _artifact(tmp_path)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_"))
+        and k != "TPU_PAXOS_SEEDED_WEDGE"
+    }
+    import __graft_entry__ as ge
+
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + ge.scrub_pythonpath(env.get("PYTHONPATH", ""))
+    )
+    p = subprocess.run(
+        [sys.executable, "-m", "tpu_paxos", "repro", path,
+         "--backend=cpu"],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "[ctl 1] degrade" in p.stdout

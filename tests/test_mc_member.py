@@ -1,0 +1,255 @@
+"""mc churn scope (PR 17): exhaustive bounded model checking of
+membership reconfiguration crossed with faults, through the member
+fleet (``analysis/mc_member.py``).
+
+Contracts: the (variant x fault-combo x seed) codec is a bijection
+with the scenario index as the stable name, churn variants respect
+the ``ChurnSchedule`` grammar (distinct vids, del-after-add, first
+event ``WAIT_NONE``), feasibility excludes crashes inside
+``{0} | churn targets`` by the named rule, gray is rejected at
+parse time by the data-driven :data:`mc_member.MEMBER_UNSUPPORTED_KINDS`
+table, and the committed ``churn`` scope certifies clean on device
+with zero warm compiles.
+
+The device dispatch tests are slow-marked (member-fleet compile);
+their fast-tier coverage is the host-only codec/validator/variant
+tests here plus test_modelcheck.py's committed-certificate count pins
+and test_member_fleet.py's lane-parity pins on the same runner.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_paxos.analysis import mc_member as mcm
+from tpu_paxos.analysis import modelcheck as mc
+from tpu_paxos.membership import churn_table as ctm
+from tpu_paxos.membership import engine as meng
+
+TINY = {
+    "n_nodes": 3, "n_instances": 8, "max_rounds": 100, "horizon": 12,
+    "plain_values": 1, "add_targets": [1], "del_targets": [1],
+    "t0_grid": [0, 4], "wait_gates": [ctm.WAIT_NONE, ctm.WAIT_APPLIED],
+    "max_events": 2,
+}
+
+
+def _committed():
+    return mc.load_scopes()["churn"]
+
+
+# ---------------- scope parse / validate ----------------
+
+def test_committed_churn_scope_loads_and_registers():
+    scope = _committed()
+    assert mc.scope_type(scope) == "churn"
+    assert isinstance(mc.enum_for(scope), mcm.ChurnEnum)
+    # "type" is part of the hash: a fault scope with coincidentally
+    # equal fields can never collide
+    assert mcm.ChurnScope.from_dict(
+        {k: v for k, v in scope.to_dict().items() if k != "type"}
+    ).sha256() == scope.sha256()
+
+
+def test_gray_rejected_by_the_data_driven_table():
+    """The rejection is table-driven, not string-matched: the error
+    text IS the table row, and dropping the row admits the kind."""
+    assert "gray" in mcm.MEMBER_UNSUPPORTED_KINDS
+    with pytest.raises(mc.ScopeError) as ei:
+        mcm.ChurnScope.from_dict(dict(
+            TINY, kinds=["gray"], intervals=[[2, 8]],
+        ))
+    assert mcm.MEMBER_UNSUPPORTED_KINDS["gray"] in str(ei.value)
+    # the fault scopes' own rejection table applies transitively
+    for kind, reason in mc.UNSUPPORTED_KINDS.items():
+        with pytest.raises(mc.ScopeError, match="churn checker"):
+            mcm.ChurnScope.from_dict(dict(
+                TINY, kinds=[kind], intervals=[[2, 8]],
+            ))
+
+
+def test_validator_named_rules():
+    with pytest.raises(mc.ScopeError, match="node 0"):
+        mcm.ChurnScope.from_dict(dict(TINY, add_targets=[0]))
+    with pytest.raises(mc.ScopeError, match="subset of add_targets"):
+        mcm.ChurnScope.from_dict(dict(TINY, del_targets=[2]))
+    with pytest.raises(mc.ScopeError, match="horizon"):
+        mcm.ChurnScope.from_dict(dict(TINY, t0_grid=[12]))
+    with pytest.raises(mc.ScopeError, match="wait_gates"):
+        mcm.ChurnScope.from_dict(dict(TINY, wait_gates=[7]))
+    with pytest.raises(mc.ScopeError, match=rf"\[1, {ctm.MAX_EVENTS}\]"):
+        mcm.ChurnScope.from_dict(
+            dict(TINY, max_events=ctm.MAX_EVENTS + 1)
+        )
+    with pytest.raises(mc.ScopeError, match="no churn letters"):
+        mcm.ChurnScope.from_dict(
+            dict(TINY, plain_values=0, add_targets=[], del_targets=[])
+        )
+    with pytest.raises(mc.ScopeError, match="unknown scope field"):
+        mcm.ChurnScope.from_dict(dict(TINY, proposers=2))
+
+
+# ---------------- codec ----------------
+
+def test_codec_bijection_exhaustive_committed():
+    """index -> scenario -> index is the identity over the ENTIRE
+    committed churn universe, and out-of-range indices raise."""
+    enum = mcm.ChurnEnum(_committed())
+    for i in range(enum.total):
+        assert enum.encode(enum.decode(i)) == i
+    for bad in (-1, enum.total):
+        with pytest.raises(IndexError):
+            enum.decode(bad)
+
+
+def test_codec_boundaries_at_churn_grid_edges():
+    """The first and last index of every variant block decode to that
+    variant with the extreme fault rank / seed — the churn-grid
+    boundary cells the mixed-radix codec must not shear."""
+    enum = mcm.ChurnEnum(_committed())
+    per_variant = enum.n_fault_combos * enum.n_seeds
+    for vi in range(enum.n_variants):
+        lo = enum.decode(vi * per_variant)
+        hi = enum.decode((vi + 1) * per_variant - 1)
+        assert lo.variant == hi.variant == vi
+        assert lo.seed == 0 and mc.combo_rank(
+            lo.combo, enum.m, enum.scope.max_fault_episodes
+        ) == 0
+        assert hi.seed == enum.n_seeds - 1 and mc.combo_rank(
+            hi.combo, enum.m, enum.scope.max_fault_episodes
+        ) == enum.n_fault_combos - 1
+
+
+def test_variant_zero_is_the_fault_only_baseline():
+    enum = mcm.ChurnEnum(_committed())
+    assert enum.variants[0] is None
+    sc = enum.decode(0)
+    assert sc.variant == 0
+    assert enum.churn_of(sc) is None
+
+
+# ---------------- variant grammar ----------------
+
+def test_variants_obey_the_schedule_grammar():
+    """Every enumerated variant materializes to a legal ChurnSchedule:
+    distinct vids, dels only after their adds, first wait forced
+    ``WAIT_NONE``, later waits drawn from the scope's gates."""
+    scope = _committed()
+    enum = mcm.ChurnEnum(scope)
+    assert enum.n_variants == len(set(map(str, enum.variants)))
+    for vi in range(1, enum.n_variants):
+        churn = enum.churn_of(
+            mcm.ChurnScenario(0, vi, (), 0)
+        )
+        vids = [e.vid for e in churn.events]
+        assert len(vids) == len(set(vids)), vi
+        assert churn.events[0].wait == ctm.WAIT_NONE, vi
+        assert all(
+            e.wait in scope.wait_gates for e in churn.events[1:]
+        ), vi
+        added = set()
+        for e in churn.events:
+            if e.vid >= meng.CHANGE_BASE:
+                node, kind = meng.decode_change(e.vid)
+                if kind == meng.DEL_ACCEPTOR:
+                    assert node in added, vi
+                else:
+                    added.add(node)
+
+
+def test_plain_and_change_vids_never_collide():
+    scope = _committed()
+    assert mcm.PLAIN_VID_BASE + scope.plain_values <= meng.CHANGE_BASE
+    enum = mcm.ChurnEnum(scope)
+    plain = {
+        mcm.PLAIN_VID_BASE + arg
+        for kind, arg, _ in enum.letters if kind == mcm.EV_PLAIN
+    }
+    change = {
+        meng.change_vid(arg, meng.ADD_ACCEPTOR)
+        for kind, arg, _ in enum.letters if kind != mcm.EV_PLAIN
+    } | {
+        meng.change_vid(arg, meng.DEL_ACCEPTOR)
+        for kind, arg, _ in enum.letters if kind != mcm.EV_PLAIN
+    }
+    assert not plain & change
+
+
+# ---------------- feasibility ----------------
+
+def test_feasibility_excludes_protected_crashes():
+    """Reduced scenarios never crash the driver or a churn-named
+    acceptor, the rule actually bites (reduced < full), and every
+    excluded scenario is excluded FOR that reason — no silent drops."""
+    enum = mcm.ChurnEnum(_committed())
+    assert len(enum.reduced) < enum.total
+    reduced = set(enum.reduced)
+    for i in range(enum.total):
+        sc = enum.decode(i)
+        protected = {0} | enum.variant_targets(sc.variant)
+        crashes = {
+            n
+            for ci in sc.combo
+            for n in enum.fault_alphabet[ci].nodes
+            if enum.fault_alphabet[ci].kind == "crash"
+        }
+        assert (i in reduced) == (not crashes & protected), i
+
+
+def test_describe_names_the_scenario():
+    enum = mcm.ChurnEnum(_committed())
+    sc = enum.decode(enum.reduced[-1])
+    d = enum.describe(sc)
+    assert d["index"] == sc.index
+    assert {e["kind"] for e in d["events"]} <= {
+        mcm.EV_PLAIN, mcm.EV_ADD, mcm.EV_DEL
+    }
+    assert d["seed"] == int(enum.scope.seeds[sc.seed])
+    json.dumps(d)  # triage-dump serializable
+
+
+# ---------------- device dispatch (slow tier) ----------------
+
+@pytest.mark.slow
+def test_churn_scope_certifies_clean_on_device():
+    """Slow tier: the committed churn scope end-to-end — verdict
+    nibbles match the pinned certificate and every chunk after the
+    first compiles nothing.  Fast-tier coverage: the codec/grammar
+    tests above + test_modelcheck.py's certificate count pins."""
+    scope = _committed()
+    summary = mcm.run_scope(scope, verbose=False)
+    cert = mc.load_certificates()["churn"]
+    assert summary["ok"], summary["counterexamples"][:2]
+    assert summary["verdict_bits_sha256"] == cert["verdict_bits_sha256"]
+    assert summary["scenarios_reduced"] == cert["scenarios_reduced"]
+    assert all(c == 0 for c in summary["compiles_per_chunk"][1:]), (
+        summary["compiles_per_chunk"]
+    )
+
+
+@pytest.mark.slow
+def test_churn_counterexample_dumps_named_artifact(tmp_path):
+    """Slow tier: a convergence budget too small to finish churn makes
+    every churn-bearing lane fail completion — the counterexample path
+    must dump deterministic ``mc_member_scenario_<index>.json``
+    artifacts carrying the scope hash and the lane's decision-log sha.
+    Fast-tier coverage: describe() serializability above."""
+    scope = mcm.ChurnScope.from_dict({
+        "n_nodes": 3, "n_instances": 8, "max_rounds": 4, "horizon": 2,
+        "plain_values": 1, "add_targets": [], "t0_grid": [0],
+        "max_events": 1, "seeds": [0], "chunk_lanes": 4,
+    })
+    summary = mcm.run_scope(
+        scope, triage_dir=str(tmp_path), verbose=False,
+        max_counterexamples=2,
+    )
+    assert not summary["ok"]
+    cx = summary["counterexamples"][0]
+    assert os.path.basename(cx["artifact"]).startswith(
+        "mc_member_scenario_"
+    )
+    with open(cx["artifact"]) as f:
+        art = json.load(f)
+    assert art["scope_sha256"] == scope.sha256()
+    assert art["decision_log_sha256"] == cx["decision_log_sha256"]

@@ -42,11 +42,15 @@ TINY = {
 
 def test_codec_roundtrip_bijection_committed_scopes():
     """THE codec contract: index -> scenario -> index is the identity
-    over the ENTIRE cross product of every committed scope (quick is
-    swept exhaustively; full is swept over a stride to stay cheap,
-    plus both boundary indices)."""
-    for name, scope in _committed_scopes().items():
-        enum = mc.ScopeEnum(scope)
+    over the ENTIRE cross product of every committed scope — fault,
+    churn, AND control scopes through their own codecs (small scopes
+    are swept exhaustively; large ones over a stride to stay cheap,
+    plus both boundary indices).  Dispatch goes through
+    ``mc.enum_for``, the same registry the CLI uses."""
+    scopes = _committed_scopes()
+    assert {"quick", "full", "gray", "churn", "control"} <= set(scopes)
+    for name, scope in scopes.items():
+        enum = mc.enum_for(scope)
         idxs = (
             range(enum.total) if enum.total <= 5000
             else [*range(0, enum.total, 97), 0, enum.total - 1]
@@ -349,6 +353,99 @@ def test_scope_episode_ceiling_matches_fleet_envelope():
         mc.McScope.from_dict(
             dict(TINY, max_episodes=mc.MAX_SCOPE_EPISODES + 1)
         )
+
+
+# ---------------- gray axis ----------------
+
+def test_gray_delay_ceiling_matches_fleet_envelope():
+    """MAX_GRAY_DELAY is hardcoded (the scope layer stays jax-free)
+    but must track the fleet envelope's delay-ring bound — the clamp
+    is what makes the delay-tier axis finite."""
+    from tpu_paxos.fleet import envelope
+
+    assert mc.MAX_GRAY_DELAY == envelope.MAX_DELAY_BOUND
+    gray = dict(
+        TINY, kinds=["gray"], gray_set_sizes=[1], gray_delays=[2],
+        knob_tiers=[{"drop_rate": 0, "max_delay": 4}],
+    )
+    mc.McScope.from_dict(gray).validate()  # baseline accepted
+    with pytest.raises(mc.ScopeError, match=r"\[1, 8\]"):
+        mc.McScope.from_dict(dict(gray, gray_delays=[9])).validate()
+    with pytest.raises(mc.ScopeError, match="distinct"):
+        mc.McScope.from_dict(dict(gray, gray_delays=[2, 2])).validate()
+    # the fleet's named zero-max_delay rejection, moved to parse time
+    with pytest.raises(mc.ScopeError, match="max_delay >= 1"):
+        mc.McScope.from_dict(
+            dict(gray, knob_tiers=[{"drop_rate": 0}])
+        ).validate()
+
+
+def test_gray_letters_materialize_at_tier_boundaries():
+    """The committed gray scope's letters carry exactly the declared
+    delay tiers, and rank/unrank is the identity at the first and
+    last index of every per-combo block touching a gray letter."""
+    scope = _committed_scopes()["gray"]
+    enum = mc.enum_for(scope)
+    letters = mc.episode_alphabet(scope)
+    grays = [ep for ep in letters if ep.kind == "gray"]
+    assert grays, "committed gray scope must produce gray letters"
+    assert {ep.delay for ep in grays} == set(scope.gray_delays)
+    per_combo = enum.n_tiers * enum.n_gates * enum.n_seeds
+    for cr in range(enum.n_combos):
+        for i in (cr * per_combo, (cr + 1) * per_combo - 1):
+            sc = enum.decode(i)
+            assert enum.encode(sc) == i
+            sched = enum.schedule_of(sc)
+            if sched is not None:
+                for ep in sched.episodes:
+                    if ep.kind == "gray":
+                        assert ep.delay in scope.gray_delays
+
+
+def test_gray_broken_symmetry_one_canonical_per_orbit():
+    """Gray letters break node symmetry the same way crash letters do
+    — the reduction must still keep exactly one spelling per
+    permutation orbit over the gray scope's alphabet."""
+    scope = _committed_scopes()["gray"]
+    enum = mc.enum_for(scope)
+    assert enum._perms, "gray scope should have movable nodes"
+    orbits = {}
+    for cr in range(enum.n_combos):
+        combo = mc.combo_unrank(cr, enum.m, scope.max_episodes)
+        canon = enum.canon_combo(combo)
+        assert enum.canon_combo(canon) == canon
+        orbits.setdefault(canon, set()).add(combo)
+    for canon, members in orbits.items():
+        assert sum(
+            1 for c in members if enum.canon_combo(c) == c
+        ) == 1, (canon, members)
+
+
+# ---------------- committed certificates ----------------
+
+def test_committed_certificates_pin_all_scopes_and_counts():
+    """Every committed scope has a pinned certificate whose shape
+    fields match the LIVE enumeration — scenario counts are pinned
+    numbers, not run output.  A scope edit that changes the universe
+    fails here without touching a device."""
+    certs = mc.load_certificates()
+    expect_counts = {
+        "quick": (2116, 928),
+        "full": (25674, 7242),
+        "gray": (121, 52),
+        "churn": (441, 302),
+        "control": (8882, 8882),
+    }
+    for name, scope in _committed_scopes().items():
+        enum = mc.enum_for(scope)
+        cert = certs[name]
+        assert cert["scope_sha256"] == scope.sha256(), name
+        assert cert["scenarios_full"] == enum.total == \
+            expect_counts[name][0], name
+        assert cert["scenarios_reduced"] == len(enum.reduced) == \
+            expect_counts[name][1], name
+        assert cert["counterexamples"] == 0, name
+        assert len(cert["verdict_bits"]) == len(enum.reduced), name
 
 
 def test_mc_artifacts_live_in_the_triage_namespace():

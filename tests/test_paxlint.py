@@ -12,7 +12,8 @@ import os
 import pytest
 
 from tpu_paxos.analysis import lint
-from tpu_paxos.analysis import rules_det  # noqa: F401  (registers RULES)
+from tpu_paxos.analysis import rules_ctl  # noqa: F401  (registers RULES)
+from tpu_paxos.analysis import rules_det  # noqa: F401
 from tpu_paxos.analysis import rules_jax  # noqa: F401
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -471,6 +472,71 @@ def test_jax104_lax_bodies_exempt():
     assert rules_of(src, replay_critical=False) == []
 
 
+# ---------------- CTL001: raw cause-code literals ----------------
+
+def test_ctl001_true_positive_subscript_key():
+    src = (
+        "def is_gray(dc):\n"
+        "    return dc['cause_id'] == 2\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["CTL001"]
+
+
+def test_ctl001_true_positive_membership():
+    # `in`/`not in` against a cause_ids list is the same smell
+    src = (
+        "def vetoed(dc):\n"
+        "    return 2 in dc['cause_ids']\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["CTL001"]
+
+
+def test_ctl001_true_positive_call_result():
+    src = (
+        "from tpu_paxos.telemetry import diagnose as diag\n\n"
+        "def f(name):\n"
+        "    return diag.cause_code(name) != 3\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["CTL001"]
+
+
+def test_ctl001_true_negative_named_lookup():
+    # the sanctioned spelling: compare against the named table row
+    src = (
+        "from tpu_paxos.telemetry import diagnose as diag\n\n"
+        "def is_gray(dc):\n"
+        "    return dc['cause_id'] == diag.CAUSE_IDS['gray-region']\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_ctl001_true_negative_unrelated_int_compare():
+    # int literals against non-cause expressions are none of CTL001's
+    # business
+    src = (
+        "def f(dc):\n"
+        "    return dc['level'] == 2 and len(dc['windows']) > 0\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_ctl001_true_negative_bool_literal():
+    # True/False are ints to the interpreter but not wire codes
+    src = (
+        "def f(dc):\n"
+        "    return dc['cause_known'] == True  # noqa: E712\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_ctl001_exempt_in_table_owner(tmp_path):
+    # diagnose.py OWNS the name<->code table; relating literals to
+    # names there is the module's whole job
+    src = "CAUSE_IDS = {'unknown': 0}\nOK = CAUSE_IDS['unknown'] == 0\n"
+    assert rules_of(src, replay_critical=False,
+                    path="tpu_paxos/telemetry/diagnose.py") == []
+
+
 # ---------------- pragmas ----------------
 
 def test_pragma_same_line():
@@ -612,6 +678,7 @@ def test_replay_closure_includes_log_via_package_init():
 
 def test_every_rule_documented():
     assert set(lint.RULES) == {
+        "CTL001",
         "DET001", "DET002", "DET003", "DET004",
         "JAX101", "JAX102", "JAX103", "JAX104",
     }

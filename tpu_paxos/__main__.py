@@ -521,6 +521,11 @@ def run_repro(argv) -> int:
         # controller (serve/control.reproduce): same schema surface,
         # decision log extended with the control trail
         from tpu_paxos.serve import control as shr
+    elif engine == "mc-control":
+        # controller-invariant counterexamples replay as a pure host
+        # decide() trail (analysis/mc_control.reproduce): the artifact
+        # carries the full policy, so no wedge env is needed
+        from tpu_paxos.analysis import mc_control as shr
     else:
         from tpu_paxos.harness import shrink as shr
 

@@ -2,7 +2,8 @@
 
 The engine is deliberately jax-free (pure ``ast`` + stdlib): it must
 run in CI images without an accelerator stack and finish in seconds.
-Rule logic lives in the family modules (``rules_det``, ``rules_jax``);
+Rule logic lives in the family modules (``rules_det``,
+``rules_jax``, ``rules_ctl``);
 this module owns everything shared:
 
 - **File walk & module naming** — lints ``tpu_paxos/**/*.py`` by
@@ -395,7 +396,7 @@ def lint_files(
     (baseline NOT applied — that is the caller's policy decision).
     ``files`` lets a caller that already walked the tree skip the
     second walk."""
-    from tpu_paxos.analysis import rules_det, rules_jax
+    from tpu_paxos.analysis import rules_ctl, rules_det, rules_jax
 
     if files is None:
         files = walk_files(root, paths)
@@ -427,7 +428,8 @@ def lint_files(
             lines=source.splitlines(), replay_critical=critical,
         )
         attach_parents(tree)
-        raw = rules_det.check_module(ctx) + rules_jax.check_module(ctx)
+        raw = (rules_det.check_module(ctx) + rules_jax.check_module(ctx)
+               + rules_ctl.check_module(ctx))
         allowed = pragma_map(ctx.lines)
         findings.extend(f for f in raw if not _suppressed(f, allowed))
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
@@ -438,7 +440,7 @@ def lint_source(
     source: str, path: str = "fixture.py", replay_critical: bool = True
 ) -> list[Finding]:
     """Lint a source string (the fixture-test entry point)."""
-    from tpu_paxos.analysis import rules_det, rules_jax
+    from tpu_paxos.analysis import rules_ctl, rules_det, rules_jax
 
     tree = ast.parse(source, filename=path)
     ctx = ModuleContext(
@@ -446,7 +448,8 @@ def lint_source(
         lines=source.splitlines(), replay_critical=replay_critical,
     )
     attach_parents(tree)
-    raw = rules_det.check_module(ctx) + rules_jax.check_module(ctx)
+    raw = (rules_det.check_module(ctx) + rules_jax.check_module(ctx)
+               + rules_ctl.check_module(ctx))
     allowed = pragma_map(ctx.lines)
     out = [f for f in raw if not _suppressed(f, allowed)]
     out.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
@@ -522,7 +525,7 @@ def main(argv=None) -> int:
         ap.error("--fix does not support --json (the diff IS the "
                  "output; run a plain --json pass for the report)")
     if args.rules:
-        from tpu_paxos.analysis import rules_det, rules_jax  # noqa: F401
+        from tpu_paxos.analysis import rules_ctl, rules_det, rules_jax  # noqa: F401
 
         for rid, doc in sorted(RULES.items()):
             print(f"{rid}  {doc}")

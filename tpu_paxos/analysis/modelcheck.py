@@ -17,7 +17,17 @@ The pieces:
 - **Scope** (:class:`McScope`, ``analysis/mc_scope.json``): the
   declared bounds.  Everything is quantized to a finite alphabet of
   episodes (:func:`episode_alphabet`) plus finite knob/gate/seed
-  axes, so the scenario space is a computable integer.
+  axes, so the scenario space is a computable integer.  Gray/WAN
+  weather is a first-class axis: ``gray(t0, t1, *nodes, delay=k)``
+  letters ride a quantized delay-tier grid (``gray_delays``, finite
+  because the engines clamp inflated delays at the envelope's ring
+  bound — :data:`MAX_GRAY_DELAY`).  Scope files may also declare
+  CHURN scopes (``"type": "churn"`` — bounded membership-change
+  grids through the member fleet, ``analysis/mc_member.py``) and
+  CONTROLLER scopes (``"type": "control"`` — the admission
+  controller's policy invariants, ``analysis/mc_control.py``); all
+  three types share this module's codec helpers, chunking, and
+  certificate machinery.
 - **Codec**: a bijective index <-> scenario mapping
   (:meth:`ScopeEnum.decode` / :meth:`ScopeEnum.encode`) over the
   mixed-radix cross product (episode combination, knob tier, gate
@@ -111,6 +121,27 @@ MAX_PERMS = 5040
 #: counterexample triage.
 MAX_SCOPE_EPISODES = 8
 
+#: Gray delay-tier ceiling == fleet.envelope.MAX_DELAY_BOUND (the
+#: floor of every fleet envelope's delay ring; cross-checked by
+#: tests/test_modelcheck.py, hardcoded for the same jax-free reason
+#: as MAX_SCOPE_EPISODES).  The engines clamp the INFLATED per-
+#: message delay at the ring bound, so a gray tier past it would be
+#: indistinguishable from the tier AT it — the clamp is exactly what
+#: makes the delay axis finite, and the validator keeps the declared
+#: grid inside the distinguishable range.
+MAX_GRAY_DELAY = 8
+
+#: Episode kinds the letter builder cannot enumerate: kind -> reason.
+#: NAMED rejection, never silent exclusion — a scope declaring a kind
+#: listed here fails loudly rather than certifying a universe it
+#: silently never enumerated.  Empty today: every ``faults.KINDS``
+#: member has a codec axis (gray landed with the quantized
+#: ``gray_delays`` tier grid).  The table stays so a future grammar
+#: kind lands HERE (with its reason) until its axis exists, and so
+#: sibling scope validators (mc_member's member-engine alphabet) can
+#: declare their own rejections the same data-driven way.
+UNSUPPORTED_KINDS: dict[str, str] = {}
+
 
 class ScopeError(Exception):
     """The scope file is malformed or internally inconsistent."""
@@ -136,6 +167,13 @@ class McScope:
     #: interval grid — a crash is an instant, not a window.
     crash_rounds: tuple = ()
     crash_set_sizes: tuple = (1,)
+    #: gray axis (PR-13 weather joins the alphabet): one letter per
+    #: (interval x node set x delay tier).  ``gray_delays`` is the
+    #: quantized delay-tier grid — empty unless "gray" is in kinds.
+    #: Both fields serialize ONLY when non-default (to_dict elides
+    #: them) so pre-gray scopes hash — and certify — byte-identically.
+    gray_set_sizes: tuple = (1,)
+    gray_delays: tuple = ()
     max_episodes: int = 2  # scenarios combine up to this many episodes
     knob_tiers: tuple = ()  # (FaultConfig kwargs dict, ...) — crash points
     gate_tiers: tuple = (True,)  # workload-gate on/off axis
@@ -150,10 +188,16 @@ class McScope:
         "n_nodes", "proposers", "horizon", "max_rounds", "intervals",
         "kinds", "partition_group_sizes", "pause_set_sizes",
         "burst_rates", "crash_rounds", "crash_set_sizes",
+        "gray_set_sizes", "gray_delays",
         "max_episodes", "knob_tiers", "gate_tiers",
         "seeds", "symmetry_reduction", "chunk_lanes", "workload_seed",
         "n_ids", "n_free",
     )
+
+    #: Fields added AFTER certificates were first pinned: serialized
+    #: only when non-default, so every pre-existing scope's sha256 —
+    #: and therefore its pinned certificate — stays byte-identical.
+    _ELIDED_DEFAULTS = {"gray_set_sizes": (1,), "gray_delays": ()}
 
     @classmethod
     def from_dict(cls, d: dict) -> "McScope":
@@ -175,6 +219,7 @@ class McScope:
         )
         for f in ("kinds", "partition_group_sizes", "pause_set_sizes",
                   "burst_rates", "crash_rounds", "crash_set_sizes",
+                  "gray_set_sizes", "gray_delays",
                   "gate_tiers", "seeds"):
             if f in kw:
                 kw[f] = tuple(kw[f])
@@ -195,6 +240,13 @@ class McScope:
                   "gate_tiers", "seeds"):
             d[f] = list(d[f])
         d["knob_tiers"] = [dict(t) for t in self.knob_tiers]
+        for f, dflt in self._ELIDED_DEFAULTS.items():
+            # post-pin fields leave the serialization (and the
+            # sha256) untouched at their defaults — see _ELIDED_DEFAULTS
+            if getattr(self, f) == dflt:
+                del d[f]
+            else:
+                d[f] = list(d[f])
         return d
 
     def sha256(self) -> str:
@@ -224,19 +276,41 @@ class McScope:
         bad = sorted(set(self.kinds) - set(fltm.KINDS))
         if bad:
             raise ScopeError(f"unknown episode kind(s): {', '.join(bad)}")
+        for k in self.kinds:
+            if k in UNSUPPORTED_KINDS:
+                raise ScopeError(
+                    f"episode kind {k!r} is not enumerable by this "
+                    f"checker: {UNSUPPORTED_KINDS[k]}"
+                )
         if "gray" in self.kinds:
-            # NAMED rejection, never silent exclusion: the letter
-            # builder below has no gray axis yet (a gray letter needs
-            # a (nodes x delay-tier) grid and its own symmetry
-            # story), so a scope declaring it must fail loudly rather
-            # than certify a universe it silently never enumerated.
-            raise ScopeError(
-                "gray episodes are not enumerable by this checker yet: "
-                "remove 'gray' from kinds (the stress WAN mixes and "
-                "the fleet search's --gray grammar cover gray "
-                "failures; an exhaustive gray scope needs a delay-"
-                "tier axis in the codec)"
-            )
+            if not self.gray_delays:
+                raise ScopeError("gray in kinds needs gray_delays")
+            if len(set(self.gray_delays)) != len(self.gray_delays):
+                raise ScopeError("gray_delays must be distinct")
+            for dly in self.gray_delays:
+                if not 1 <= dly <= MAX_GRAY_DELAY:
+                    raise ScopeError(
+                        f"gray_delays entries must be in "
+                        f"[1, {MAX_GRAY_DELAY}] (the fleet envelope's "
+                        "delay-ring bound — the engines clamp inflated "
+                        "delays there, so tiers past it collapse into "
+                        "the boundary tier)"
+                    )
+        elif self.gray_delays:
+            raise ScopeError("gray_delays declared without gray in kinds")
+        if "gray" in self.kinds:
+            # the fleet's named dispatch rejection, moved to scope
+            # parse time: the delay-inflation clamp is each lane's OWN
+            # declared bound (fleet/runner._knob_arrays), so a zero-
+            # max_delay tier would turn every gray letter into a no-op
+            for t in self.knob_tiers:
+                if int(t.get("max_delay", 0)) < 1:
+                    raise ScopeError(
+                        f"gray in kinds needs max_delay >= 1 on every "
+                        f"knob tier (tier {t} clamps gray inflation "
+                        "to its own declared bound; at 0 every gray "
+                        "episode is a no-op)"
+                    )
         if "burst" in self.kinds and not self.burst_rates:
             raise ScopeError("burst in kinds needs burst_rates")
         for r in self.burst_rates:
@@ -253,6 +327,7 @@ class McScope:
             (self.partition_group_sizes, "partition_group_sizes"),
             (self.pause_set_sizes, "pause_set_sizes"),
             (self.crash_set_sizes, "crash_set_sizes"),
+            (self.gray_set_sizes, "gray_set_sizes"),
         ):
             for k in sizes:
                 if not 1 <= k < self.n_nodes:
@@ -295,8 +370,34 @@ class McScope:
                 )
 
 
-def load_scopes(path: str = DEFAULT_SCOPE) -> dict[str, McScope]:
-    """Parse the scope file: a JSON object of name -> scope."""
+def _scope_types() -> dict:
+    """The scope-type registry: JSON ``"type"`` discriminator ->
+    ``(scope_cls, enum_cls, run_fn)``.  ``"fault"`` (the default, and
+    the only type pre-gray scope files could name) is this module's
+    own McScope/ScopeEnum/run_scope; the churn and controller scopes
+    live in sibling modules that import THIS module for the shared
+    codec/certificate machinery, so the registry is built lazily to
+    keep the import acyclic (and the codec layer jax-free)."""
+    from tpu_paxos.analysis import mc_control, mc_member
+
+    return {
+        "fault": (McScope, ScopeEnum, run_scope),
+        "churn": (
+            mc_member.ChurnScope, mc_member.ChurnEnum,
+            mc_member.run_scope,
+        ),
+        "control": (
+            mc_control.ControlScope, mc_control.ControlEnum,
+            mc_control.run_scope,
+        ),
+    }
+
+
+def load_scopes(path: str = DEFAULT_SCOPE) -> dict:
+    """Parse the scope file: a JSON object of name -> scope.  Each
+    entry's optional ``"type"`` field picks the scope family
+    (:func:`_scope_types`); absent = ``"fault"``, so pre-existing
+    scope files parse — and hash — exactly as before."""
     try:
         with open(path) as f:
             raw = json.load(f)
@@ -306,13 +407,45 @@ def load_scopes(path: str = DEFAULT_SCOPE) -> dict[str, McScope]:
         raise ScopeError(f"invalid scope JSON: {e}") from None
     if not isinstance(raw, dict) or not raw:
         raise ScopeError("scope file must map scope names to scopes")
+    types = _scope_types()
     out = {}
     for name in sorted(raw):
+        d = raw[name]
+        kind = d.get("type", "fault") if isinstance(d, dict) else "fault"
+        if kind not in types:
+            raise ScopeError(
+                f"scope {name!r}: unknown scope type {kind!r} "
+                f"(one of {', '.join(sorted(types))})"
+            )
+        cls = types[kind][0]
+        if kind != "fault":
+            d = {k: v for k, v in d.items() if k != "type"}
         try:
-            out[name] = McScope.from_dict(raw[name])
+            out[name] = cls.from_dict(d)
         except ScopeError as e:
             raise ScopeError(f"scope {name!r}: {e}") from None
     return out
+
+
+def scope_type(scope) -> str:
+    """A loaded scope's type discriminator (its class name is not the
+    contract; the registry key is)."""
+    for kind, (cls, _, _) in _scope_types().items():
+        if isinstance(scope, cls):
+            return kind
+    raise ScopeError(f"unregistered scope object {type(scope).__name__}")
+
+
+def enum_for(scope):
+    """The scope's enumerator (``.reduced`` is the dispatch order the
+    certificate's verdict nibbles follow, for every scope type)."""
+    return _scope_types()[scope_type(scope)][1](scope)
+
+
+def run_for(scope):
+    """The scope's run function (``run_scope``-shaped: same kwargs,
+    same summary keys — the certificate machinery is shared)."""
+    return _scope_types()[scope_type(scope)][2]
 
 
 # ---------------- episode alphabet ----------------
@@ -322,10 +455,10 @@ def _table_key(e: fltm.Episode, n_nodes: int) -> tuple:
     masks the engine actually sees (faults.episode_tables).  Two
     grammar spellings with equal masks — e.g. a partition group and
     its complement — are the same letter."""
-    cut, paused, extra, crash_m, _gray = fltm.episode_tables(e, n_nodes)
+    cut, paused, extra, crash_m, gray_v = fltm.episode_tables(e, n_nodes)
     return (
         e.t0, e.t1, cut.tobytes(), paused.tobytes(), int(extra),
-        crash_m.tobytes(),
+        crash_m.tobytes(), gray_v.tobytes(),
     )
 
 
@@ -363,6 +496,13 @@ def episode_alphabet(scope: McScope) -> list[fltm.Episode]:
             elif kind == "burst":
                 for r in scope.burst_rates:
                     add(fltm.burst(t0, t1, int(r)))
+            elif kind == "gray":
+                # the (node set x delay tier) grid: the ring-bound
+                # clamp (MAX_GRAY_DELAY) already bounded the tiers
+                for k in scope.gray_set_sizes:
+                    for grp in combinations(nodes, k):
+                        for dly in scope.gray_delays:
+                            add(fltm.gray(t0, t1, *grp, delay=int(dly)))
     # crash points ride their own round grid (a crash is an instant,
     # not a window), appended after the interval letters
     if "crash" in scope.kinds:
@@ -389,6 +529,14 @@ def _permute_episode(e: fltm.Episode, perm: dict[int, int]) -> fltm.Episode:
         return fltm.pause(e.t0, e.t1, *(perm[x] for x in e.nodes))
     if e.kind == "crash":
         return fltm.crash(e.t0, *(perm[x] for x in e.nodes))
+    if e.kind == "gray":
+        # gray names nodes exactly like pause — the delay tier rides
+        # along unchanged, so gray letters break acceptor symmetry
+        # the same way pause sets do (closure = full node-set orbit
+        # per delay tier)
+        return fltm.gray(
+            e.t0, e.t1, *(perm[x] for x in e.nodes), delay=e.delay
+        )
     return e  # burst names no nodes
 
 
@@ -890,7 +1038,9 @@ def main(argv=None) -> int:
         "gate on the pinned scope certificate",
     )
     ap.add_argument("--scope", default="quick",
-                    help="scope name in the scope file (default: quick)")
+                    help="comma-separated scope name(s) in the scope "
+                    "file (default: quick); scopes sharing an engine "
+                    "envelope share its compile within one invocation")
     ap.add_argument("--scope-file", default=DEFAULT_SCOPE)
     ap.add_argument("--cert-file", default=DEFAULT_CERT)
     ap.add_argument("--chunk-limit", type=int, default=0,
@@ -911,26 +1061,39 @@ def main(argv=None) -> int:
     from tpu_paxos.__main__ import _select_backend
 
     _select_backend(args.backend)
+    names = [n for n in args.scope.split(",") if n]
     try:
         scopes = load_scopes(args.scope_file)
-        if args.scope not in scopes:
-            raise ScopeError(
-                f"scope {args.scope!r} not in {args.scope_file} "
-                f"(available: {', '.join(sorted(scopes))})"
-            )
-        scope = scopes[args.scope]
-        enum = ScopeEnum(scope)
+        plan = []
+        for name in names:
+            if name not in scopes:
+                raise ScopeError(
+                    f"scope {name!r} not in {args.scope_file} "
+                    f"(available: {', '.join(sorted(scopes))})"
+                )
+            plan.append((name, scopes[name], enum_for(scopes[name])))
+        if not plan:
+            raise ScopeError("--scope named no scopes")
     except ScopeError as e:
         print(f"mc: {e}", file=sys.stderr)
         return 2
-    summary = run_scope(
+    rc = 0
+    for name, scope, enum in plan:
+        rc = max(rc, _run_one(name, scope, enum, args))
+    return rc
+
+
+def _run_one(name, scope, enum, args) -> int:
+    """Run + certificate-gate one scope (any type); the CLI's exit
+    code is the max over the listed scopes."""
+    summary = run_for(scope)(
         scope,
         triage_dir=args.triage_dir or None,
         verbose=not args.quiet,
         max_counterexamples=args.max_counterexamples,
         chunk_limit=args.chunk_limit or None,
     )
-    summary["scope"] = args.scope
+    summary["scope"] = name
     pin = args.pin or os.environ.get(PIN_ENV, "") == "1"
     full_run = summary["chunks_run"] == summary["chunks"]
     cert_fails: list[str] = []
@@ -949,14 +1112,14 @@ def main(argv=None) -> int:
             )
             return 1
         save_certificate(
-            args.cert_file, args.scope, make_certificate(summary)
+            args.cert_file, name, make_certificate(summary)
         )
         summary["pinned"] = args.cert_file
     else:
-        pinned = load_certificates(args.cert_file).get(args.scope)
+        pinned = load_certificates(args.cert_file).get(name)
         if pinned is None:
             cert_fails = [
-                f"no pinned certificate for scope {args.scope!r} "
+                f"no pinned certificate for scope {name!r} "
                 f"in {args.cert_file}; pin with {PIN_ENV}=1"
             ]
         elif full_run:
@@ -980,7 +1143,7 @@ def main(argv=None) -> int:
             print(f"mc: {fail}", file=sys.stderr)
         status = "SCOPE CLEAN" if ok else "FAILED"
         print(
-            f"[mc:{args.scope}] {status} "
+            f"[mc:{name}] {status} "
             f"({summary['scenarios_reduced']}/{summary['scenarios_full']} "
             f"scenarios post-reduction, {summary['chunks_run']}/"
             f"{summary['chunks']} chunks, "

@@ -40,6 +40,7 @@ AUDIT_PROVIDERS = (
     "tpu_paxos.fleet.runner",
     "tpu_paxos.fleet.member_runner",
     "tpu_paxos.analysis.modelcheck",
+    "tpu_paxos.analysis.mc_member",
     "tpu_paxos.serve.driver",
     "tpu_paxos.serve.fleet",
     "tpu_paxos.serve.control",

@@ -182,6 +182,36 @@ def policy_from_dict(d: dict) -> ControlPolicy:
     )
 
 
+#: The seeded CONTROLLER wedge value (checker-recall knob, the
+#: policy-plane sibling of ``core/sim.seeded_wedge``'s ``takeover``):
+#: ``TPU_PAXOS_SEEDED_WEDGE=shed-on-gray`` makes
+#: :func:`wedged_policy` rewriting ACTIVE in the mc controller scope's
+#: policy materialization — the exact bug the never-shed-on-gray veto
+#: exists to prevent.  Unlike ``takeover`` this selects no traced
+#: program (pure host policy data), but the same hygiene applies: any
+#: armed wedge value makes certificates unpinnable (``mc --pin``
+#: refuses).
+WEDGE_SHED_ON_GRAY = "shed-on-gray"
+
+
+def seeded_policy_wedge() -> bool:
+    """True iff the seeded controller wedge is armed (test-only; see
+    core/sim.seeded_wedge — never set in production runs)."""
+    from tpu_paxos.core import sim as simm
+
+    return simm.seeded_wedge() == WEDGE_SHED_ON_GRAY
+
+
+def wedged_policy(p: ControlPolicy) -> ControlPolicy:
+    """``p`` with its gray-region row forced to ``shed`` — the seeded
+    policy bug the mc controller scope must provably find (the
+    gray-veto invariant then fails on every gray-naming window).
+    Deterministic: the table is re-sorted by cause code."""
+    table = dict(p.table)
+    table[diag.CAUSE_IDS["gray-region"]] = "shed"
+    return dataclasses.replace(p, table=tuple(sorted(table.items())))
+
+
 @dataclasses.dataclass
 class ControllerState:
     """The controller's host-side state between dispatches: the
