@@ -4,11 +4,11 @@
 
 PY ?= python
 
-.PHONY: test test-slow check lint lint-json audit audit-json bench \
-	bench-sharded parity parity-fast replay-diff replay-diff-member \
-	run stress stress-quick fleet fleet-quick mc mc-quick serve \
-	serve-quick serve-fleet serve-fleet-quick serve-control \
-	serve-control-quick clean
+.PHONY: test test-slow check lint lint-json audit audit-json \
+	shard-audit bench bench-sharded parity parity-fast replay-diff \
+	replay-diff-member run stress stress-quick fleet fleet-quick mc \
+	mc-quick serve serve-quick serve-fleet serve-fleet-quick \
+	serve-control serve-control-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -51,6 +51,17 @@ audit:
 audit-json:
 	$(AUDIT_ENV) $(PY) -m tpu_paxos audit --hlo --json
 
+# shard-audit: mesh-polymorphic SPMD contracts
+# (tpu_paxos/analysis/shard_audit.py) — partition-rule coverage
+# (SH301), per-mesh replication ceilings + collective census
+# (SH302/SH303, analysis/shard_budget.json), and cross-mesh parity
+# certificates (SH304, analysis/shard_certificate.json) over the
+# virtual {1,2,4,8} mesh grid the AUDIT_ENV provisions.  Re-pin:
+# TPU_PAXOS_SHARD_PIN=1 make shard-audit (certificate) /
+# TPU_PAXOS_SHARD_BUDGET_PIN=1 make shard-audit (budget).
+shard-audit:
+	$(AUDIT_ENV) $(PY) -m tpu_paxos audit --shard-only
+
 # Sanitizer pass (ref multi/val.sh runs the suite under valgrind): the
 # static analyzers first (cheapest signal), then the quick-scope model
 # check (protocol-level gate; the full scope stays out of the fast
@@ -58,7 +69,7 @@ audit-json:
 # un-jitted op-by-op smoke of one tiny config per engine (every cond
 # predicate, slice bound, and dtype materializes eagerly).  The pallas
 # interpreter path is part of the fast tier (tests/test_fastwin.py).
-check: lint audit mc-quick serve-quick serve-fleet-quick serve-control-quick
+check: lint audit shard-audit mc-quick serve-quick serve-fleet-quick serve-control-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 

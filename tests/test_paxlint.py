@@ -15,6 +15,7 @@ from tpu_paxos.analysis import lint
 from tpu_paxos.analysis import rules_ctl  # noqa: F401  (registers RULES)
 from tpu_paxos.analysis import rules_det  # noqa: F401
 from tpu_paxos.analysis import rules_jax  # noqa: F401
+from tpu_paxos.analysis import rules_shard  # noqa: F401
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -537,6 +538,67 @@ def test_ctl001_exempt_in_table_owner(tmp_path):
                     path="tpu_paxos/telemetry/diagnose.py") == []
 
 
+# ---------------- SH001: sharding primitives stay in parallel/ ------
+
+def test_sh001_true_positive_partitionspec_import():
+    src = "from jax.sharding import PartitionSpec as P\n"
+    assert rules_of(src, replay_critical=False) == ["SH001"]
+
+
+def test_sh001_true_positive_raw_shard_map_import():
+    # both spellings of the raw tiling import are the same bypass
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert rules_of(src, replay_critical=False) == ["SH001"]
+    src = "import jax.experimental.shard_map\n"
+    assert rules_of(src, replay_critical=False) == ["SH001"]
+
+
+def test_sh001_true_positive_dotted_reference():
+    # no import to catch: the dotted reference itself bakes in the
+    # hand-built spec
+    src = (
+        "import jax\n\n"
+        "def spec():\n"
+        "    return jax.sharding.PartitionSpec('i')\n"
+    )
+    assert rules_of(src, replay_critical=False) == ["SH001"]
+
+
+def test_sh001_true_negative_table_and_mesh_surface():
+    # the sanctioned spelling: specs from the committed table, tiling
+    # through the validating wrapper
+    src = (
+        "from tpu_paxos.parallel import mesh as pmesh\n"
+        "from tpu_paxos.parallel import partition_rules as prules\n\n"
+        "def tile(fn, mesh, state):\n"
+        "    spec = prules.tree_spec('fleet', state, mesh.axis_names)\n"
+        "    return pmesh.shard_map(\n"
+        "        fn, mesh, in_specs=(spec,), out_specs=spec)\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_sh001_true_negative_unrelated_jax_sharding_import():
+    # Mesh itself is not a spec primitive; importing it is not the
+    # smell SH001 hunts
+    src = "from jax.sharding import Mesh\n"
+    assert rules_of(src, replay_critical=False) == []
+
+
+def test_sh001_exempt_in_parallel_owner():
+    src = "from jax.sharding import PartitionSpec as P\n"
+    assert rules_of(src, replay_critical=False,
+                    path="tpu_paxos/parallel/mesh.py") == []
+
+
+def test_sh001_pragma_suppresses():
+    src = (
+        "from jax.sharding import PartitionSpec as P"
+        "  # paxlint: allow[SH001] fixture builds a raw collective\n"
+    )
+    assert rules_of(src, replay_critical=False) == []
+
+
 # ---------------- pragmas ----------------
 
 def test_pragma_same_line():
@@ -681,6 +743,7 @@ def test_every_rule_documented():
         "CTL001",
         "DET001", "DET002", "DET003", "DET004",
         "JAX101", "JAX102", "JAX103", "JAX104",
+        "SH001",
     }
 
 

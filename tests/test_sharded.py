@@ -58,7 +58,7 @@ def test_sharded_respects_preaccepted_across_shards():
     # Seed max_seen so the new proposer must out-ballot (3,1).
     max_seen = np.asarray(state.max_seen).copy()
     max_seen[:] = int(bal.make(3, 1))
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P  # paxlint: allow[SH001] test pre-places a corrupted state by hand
 
     minor_i = NamedSharding(m, P(None, pmesh.INSTANCE_AXIS))
     state = fast.FastState(

@@ -494,10 +494,28 @@ def main(argv=None) -> int:
     ap.add_argument("--hlo-goldens", default=None,
                     help="golden dir for normalized compiled HLO "
                     "(default: tests/data/hlo)")
+    ap.add_argument("--shard", action="store_true",
+                    help="also run the mesh-polymorphic SPMD tier "
+                    "(analysis/shard_audit.py): partition-rule "
+                    "coverage, per-mesh replication/collective "
+                    "budgets, cross-mesh parity certificates")
+    ap.add_argument("--shard-only", action="store_true",
+                    help="run ONLY the mesh-polymorphic SPMD tier "
+                    "(what make shard-audit runs)")
+    ap.add_argument("--shard-budget", default=None,
+                    help="shard budget file (default: "
+                    "analysis/shard_budget.json)")
+    ap.add_argument("--shard-cert", default=None,
+                    help="shard parity-certificate file (default: "
+                    "analysis/shard_certificate.json)")
     args = ap.parse_args(argv)
 
     if args.rules:
+        from tpu_paxos.analysis import shard_rules as _shr
+
         for rid, doc in sorted(ir_rules.RULES.items()):
+            print(f"{rid}  {doc}")
+        for rid, doc in sorted(_shr.RULES.items()):
             print(f"{rid}  {doc}")
         return 0
     providers = (
@@ -544,9 +562,19 @@ def main(argv=None) -> int:
     run_hlo = args.hlo or args.hlo_only or (
         os.environ.get(hlo_audit.PIN_ENV, "") not in ("", "0")
     )
+    from tpu_paxos.analysis import shard_rules as shr
+
+    shard_pin = os.environ.get(shr.PIN_ENV, "") not in ("", "0")
+    shard_budget_pin = not args.no_budget and (
+        os.environ.get(shr.BUDGET_PIN_ENV, "") not in ("", "0")
+    )
+    run_shard = (
+        args.shard or args.shard_only or shard_pin or shard_budget_pin
+    )
     hreport = None
+    sreport = None
     report = None
-    if not args.hlo_only:
+    if not args.hlo_only and not args.shard_only:
         try:
             report = run_audit(
                 providers=providers,
@@ -557,7 +585,7 @@ def main(argv=None) -> int:
         except regm.RegistryError as e:
             print(f"jaxpr-audit: {e}")
             return 2
-    if run_hlo:
+    if run_hlo and not args.shard_only:
         try:
             hreport = hlo_audit.run_hlo_audit(
                 providers=providers,
@@ -572,16 +600,44 @@ def main(argv=None) -> int:
         except regm.RegistryError as e:
             print(f"hlo-audit: {e}")
             return 2
+    if run_shard and not args.hlo_only:
+        from tpu_paxos.analysis import shard_audit
+
+        try:
+            sreport = shard_audit.run_shard_audit(
+                providers=providers,
+                budget_path=(
+                    None if args.no_budget
+                    else args.shard_budget or shr.DEFAULT_BUDGET
+                ),
+                cert_path=args.shard_cert or shr.DEFAULT_CERT,
+                pin=shard_pin,
+                pin_budget=shard_budget_pin,
+                triage_dir=args.triage_dir,
+            )
+        except (regm.RegistryError, ValueError) as e:
+            print(f"shard-audit: {e}")
+            return 2
     if args.hlo_only:
         if args.json:
             print(json.dumps(hreport, indent=1, sort_keys=True))
         else:
             _print_hlo(hreport, hlo_pin)
         return 0 if hreport["ok"] else 1
+    if args.shard_only:
+        if args.json:
+            print(json.dumps(sreport, indent=1, sort_keys=True))
+        else:
+            _print_shard(sreport, shard_pin, shard_budget_pin)
+        return 0 if sreport["ok"] else 1
     if hreport is not None:
         report = dict(report)
         report["hlo"] = hreport
         report["ok"] = report["ok"] and hreport["ok"]
+    if sreport is not None:
+        report = dict(report)
+        report["shard"] = sreport
+        report["ok"] = report["ok"] and sreport["ok"]
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:
@@ -614,6 +670,8 @@ def main(argv=None) -> int:
         )
         if hreport is not None:
             _print_hlo(hreport, hlo_pin)
+        if sreport is not None:
+            _print_shard(sreport, shard_pin, shard_budget_pin)
     return 0 if report["ok"] else 1
 
 
@@ -650,4 +708,72 @@ def _print_hlo(hreport: dict, pinned: bool) -> None:
         f"{len(hreport['donation'])} donation violations, "
         f"{len(hreport['budget']['violations'])} budget/golden "
         f"violations"
+    )
+
+
+def _print_shard(sreport: dict, pinned: bool, budget_pinned: bool) -> None:
+    """Human-readable epilogue for the mesh-polymorphic SPMD tier."""
+    from tpu_paxos.analysis import shard_rules as shr
+
+    cov = sreport["coverage"]
+    for u in cov["unmatched"]:
+        print(
+            f"shard SH301: no committed partition rule matches leaf "
+            f"{u['path']} (entry {u['entry']}, shape {u['shape']}) — "
+            "an unruled leaf silently replicates; add a rule to "
+            "parallel/partition_rules.py"
+        )
+    for r in cov["rank"]:
+        print(
+            f"shard SH301: rule {r['rule']!r} matched {r['path']} "
+            f"but {r['detail']} (entry {r['entry']})"
+        )
+    for s in cov["stale_rules"]:
+        print(
+            f"shard SH301: stale rule {s['rule']!r} (row {s['index']}) "
+            "matches no registered state leaf — remove it from "
+            "parallel/partition_rules.py"
+        )
+    for v in sreport["budget"]["violations"]:
+        print(f"shard budget: {v['detail']}")
+    for s in sreport["budget"]["stale"]:
+        print(
+            f"shard budget: stale cell {s} — no longer measured; "
+            f"re-pin shard_budget.json ({shr.BUDGET_PIN_ENV}=1)"
+        )
+    for f in sreport["parity"]["failures"]:
+        print(f"shard SH304: {f['detail']}")
+    for d in sreport["dumped"]:
+        print(f"    shard artifact dumped: {d}")
+    if budget_pinned:
+        print(
+            f"shard budget pinned over grid {sreport['grid']} "
+            f"(backend {sreport['backend']})"
+        )
+    if pinned:
+        print(
+            f"shard parity certificate pinned "
+            f"({len(sreport['parity']['entries'])} entries, backend "
+            f"{sreport['backend']})"
+        )
+    if sreport.get("grid_truncated"):
+        print(
+            f"shard-audit: grid truncated to {sreport['grid']} — the "
+            "host exposes fewer virtual devices than the committed "
+            "grid (run under the make audit env for all shapes)"
+        )
+    if not sreport.get("enforced") and not budget_pinned:
+        print(
+            "shard-audit: budget pinned on a different backend (or "
+            "unpinned) — SH302/SH303 enforcement skipped"
+        )
+    n_cov = len(cov["unmatched"]) + len(cov["rank"]) + len(
+        cov["stale_rules"]
+    )
+    print(
+        f"shard-audit: grid {sreport['grid']}, "
+        f"{cov['leaves']} state leaves / {cov['rules']} rules, "
+        f"{n_cov} coverage problems, "
+        f"{len(sreport['budget']['violations'])} budget violations, "
+        f"{len(sreport['parity']['failures'])} parity failures"
     )

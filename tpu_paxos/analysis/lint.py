@@ -396,7 +396,9 @@ def lint_files(
     (baseline NOT applied — that is the caller's policy decision).
     ``files`` lets a caller that already walked the tree skip the
     second walk."""
-    from tpu_paxos.analysis import rules_ctl, rules_det, rules_jax
+    from tpu_paxos.analysis import (
+        rules_ctl, rules_det, rules_jax, rules_shard,
+    )
 
     if files is None:
         files = walk_files(root, paths)
@@ -429,7 +431,8 @@ def lint_files(
         )
         attach_parents(tree)
         raw = (rules_det.check_module(ctx) + rules_jax.check_module(ctx)
-               + rules_ctl.check_module(ctx))
+               + rules_ctl.check_module(ctx)
+               + rules_shard.check_module(ctx))
         allowed = pragma_map(ctx.lines)
         findings.extend(f for f in raw if not _suppressed(f, allowed))
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
@@ -440,7 +443,9 @@ def lint_source(
     source: str, path: str = "fixture.py", replay_critical: bool = True
 ) -> list[Finding]:
     """Lint a source string (the fixture-test entry point)."""
-    from tpu_paxos.analysis import rules_ctl, rules_det, rules_jax
+    from tpu_paxos.analysis import (
+        rules_ctl, rules_det, rules_jax, rules_shard,
+    )
 
     tree = ast.parse(source, filename=path)
     ctx = ModuleContext(
@@ -449,7 +454,8 @@ def lint_source(
     )
     attach_parents(tree)
     raw = (rules_det.check_module(ctx) + rules_jax.check_module(ctx)
-               + rules_ctl.check_module(ctx))
+               + rules_ctl.check_module(ctx)
+               + rules_shard.check_module(ctx))
     allowed = pragma_map(ctx.lines)
     out = [f for f in raw if not _suppressed(f, allowed)]
     out.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
@@ -525,7 +531,9 @@ def main(argv=None) -> int:
         ap.error("--fix does not support --json (the diff IS the "
                  "output; run a plain --json pass for the report)")
     if args.rules:
-        from tpu_paxos.analysis import rules_ctl, rules_det, rules_jax  # noqa: F401
+        from tpu_paxos.analysis import (  # noqa: F401
+            rules_ctl, rules_det, rules_jax, rules_shard,
+        )
 
         for rid, doc in sorted(RULES.items()):
             print(f"{rid}  {doc}")

@@ -111,6 +111,28 @@ class AuditEntry:
     #: kernels whose lowering IS the perf contract; every entry gets
     #: the per-primitive histogram + memory-ceiling budget regardless.
     hlo_golden: bool = False
+    #: --- shard-audit tier (analysis/shard_audit.py) ---
+    #: Mesh-polymorphic build: (mesh) -> (fn, args), the canonical
+    #: trace laid out over THAT mesh.  Opts the entry into the
+    #: SH302/SH303 grid — per-mesh-shape per-device memory ceilings
+    #: and the collective census (all-reduce / all-gather /
+    #: collective-permute / reduce-scatter counts) against
+    #: analysis/shard_budget.json.  The entry must build under every
+    #: shape of the committed grid (state sizes divide 8).
+    shard_build: Callable | None = None
+    #: () -> (family, stacked_state_pytree) for SH301: every array
+    #: leaf of the pytree must be matched by the committed partition
+    #: rules (parallel/partition_rules.py) under the given family
+    #: prefix, or the audit fails naming the leaf's pytree path.
+    shard_state: Callable | None = None
+    #: (n_devices) -> {"verdicts": str, "lane_logs": [str, ...]} for
+    #: SH304: run the driver end to end on an n-device mesh and
+    #: return the per-lane verdict nibbles (one hex digit per lane)
+    #: plus each lane's decision-log sha256.  The audit requires the
+    #: result bitwise identical across every mesh shape in the grid
+    #: and against the pinned certificate
+    #: (analysis/shard_certificate.json).
+    shard_parity: Callable | None = None
 
 
 class RegistryError(Exception):

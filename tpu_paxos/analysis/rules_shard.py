@@ -1,0 +1,117 @@
+"""SHARD rule family: mesh axis names stay inside ``parallel/``.
+
+The shard-audit tier (``analysis/shard_audit.py``) certifies SPMD
+layout against ONE committed source of truth: the partition-rule
+table (``parallel/partition_rules.py``) plus the mesh helpers
+(``parallel/mesh.py`` — ``instance_spec`` / ``replicated_spec`` /
+``shard_map``, which rejects specs naming axes the mesh does not
+have).  That certification is only sound if no other module
+hand-builds sharding objects: a ``PartitionSpec("i")`` spelled at a
+call site bakes in an axis-name literal the table never sees, works
+on the 1-D mesh, and silently mis-lays-out (or crashes) on the 2-D
+``('dcn', 'i')`` multi-host mesh.
+
+Rules (scope: every linted module OUTSIDE ``tpu_paxos/parallel/``,
+which owns the axis vocabulary):
+
+- SH001  importing ``PartitionSpec`` / ``NamedSharding`` from
+         ``jax.sharding`` (or ``Mesh``-building ``shard_map`` from
+         ``jax.experimental``), or referencing those dotted names —
+         build specs from the committed table instead
+         (``parallel/partition_rules.tree_spec``,
+         ``parallel/mesh.instance_spec``) and tile through
+         ``parallel/mesh.shard_map``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_paxos.analysis import lint
+
+lint.RULES.update({
+    "SH001": "hand-built sharding primitive (PartitionSpec / "
+             "NamedSharding / raw shard_map) outside tpu_paxos/parallel/",
+})
+
+#: The package that owns mesh axis names and the partition table.
+_OWNER_PREFIX = "tpu_paxos/parallel/"
+
+#: Names whose import from jax's sharding surface is the violation.
+_SHARDING_NAMES = {"PartitionSpec", "NamedSharding"}
+
+_HINT = (
+    "build specs from the committed table "
+    "(parallel/partition_rules.tree_spec, parallel/mesh.instance_spec "
+    "/ replicated_spec) and tile through parallel/mesh.shard_map; "
+    "or mark intentional: `# paxlint: allow[SH001] <reason>`"
+)
+
+
+def _dotted(expr: ast.AST) -> str:
+    """``a.b.c`` for an attribute chain rooted at a Name ('' else)."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return ""
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def check_module(ctx: lint.ModuleContext) -> list[lint.Finding]:
+    if ctx.path.replace("\\", "/").startswith(_OWNER_PREFIX):
+        return []
+    findings: list[lint.Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax.sharding":
+                for alias in node.names:
+                    if alias.name in _SHARDING_NAMES:
+                        findings.append(ctx.finding(
+                            "SH001", node,
+                            f"importing {alias.name} from jax.sharding "
+                            "outside parallel/ — the axis-name "
+                            "vocabulary and the partition table live "
+                            "in tpu_paxos/parallel",
+                            _HINT,
+                        ))
+            elif mod in ("jax.experimental.shard_map",
+                         "jax.experimental"):
+                for alias in node.names:
+                    if alias.name == "shard_map":
+                        findings.append(ctx.finding(
+                            "SH001", node,
+                            "importing raw shard_map outside "
+                            "parallel/ — parallel/mesh.shard_map is "
+                            "the one tiling surface (it validates "
+                            "spec axis names against the mesh)",
+                            _HINT,
+                        ))
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.experimental.shard_map":
+                    findings.append(ctx.finding(
+                        "SH001", node,
+                        "importing jax.experimental.shard_map outside "
+                        "parallel/ — parallel/mesh.shard_map is the "
+                        "one tiling surface",
+                        _HINT,
+                    ))
+        elif isinstance(node, ast.Attribute):
+            dotted = _dotted(node)
+            if dotted in (
+                "jax.sharding.PartitionSpec",
+                "jax.sharding.NamedSharding",
+                "jax.experimental.shard_map.shard_map",
+            ):
+                findings.append(ctx.finding(
+                    "SH001", node,
+                    f"{dotted} referenced outside parallel/ — a "
+                    "hand-built sharding primitive bypasses the "
+                    "committed partition table",
+                    _HINT,
+                ))
+    return findings

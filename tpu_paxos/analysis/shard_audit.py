@@ -1,0 +1,287 @@
+"""shard-audit: mesh-polymorphic SPMD contracts for the registered
+entries.
+
+The fifth static-analysis tier.  paxlint reads source, the jaxpr
+audit reads traced IR, the hlo audit reads ONE compiled artifact, and
+the model checker certifies lane semantics on the host — none of them
+can see how a program CHANGES as the mesh reshapes.  That is exactly
+where SPMD bugs live: a state leaf nobody ruled silently replicates
+to every device, an accidental collective appears only once the tile
+spans two devices, and a subtly mesh-dependent lane program produces
+verdicts that drift between a 1-chip dev box and an 8-chip pod.  This
+tier lowers every opted-in :class:`~tpu_paxos.analysis.registry.
+AuditEntry` under a virtual mesh grid (``MESH_GRID``, truncated to
+the devices the host exposes) and enforces four contracts:
+
+- **SH301 — partition-rule coverage.**  Every array leaf of every
+  registered stacked-state pytree (``entry.shard_state``) must match
+  a rule of the committed partition table
+  (``parallel/partition_rules.py``); unmatched leaves fail BY PYTREE
+  PATH, rules matching no leaf are stale and fail like dead budget
+  entries.  The engines build their specs from the same table, so the
+  audit certifies the layout the runtime actually uses.
+- **SH302 — replication-waste ceilings.**  Per mesh shape, each
+  compiled entry's per-device peak bytes
+  (``compiled.memory_analysis()``) stay under the pinned ceilings in
+  ``analysis/shard_budget.json`` — a leaf that stops splitting shows
+  up as a flat bytes curve and breaches the large-mesh ceilings.
+- **SH303 — collective census.**  Per mesh shape, the compiled
+  module's all-reduce / all-gather / collective-permute /
+  reduce-scatter counts equal the pinned counts EXACTLY (both
+  directions; see ``shard_rules`` for why there is no headroom).
+- **SH304 — cross-mesh parity certificates.**  The fleet drivers
+  (``entry.shard_parity``) run end to end per mesh shape; per-lane
+  verdict nibbles + per-lane decision-log sha256 must be bitwise
+  identical across every shape AND match the pinned
+  ``analysis/shard_certificate.json``.  Drift names the first
+  diverging (entry, mesh, lane) — the reproduction target.
+
+``python -m tpu_paxos audit --shard`` (what ``make shard-audit``
+runs via ``--shard-only``) adds this tier after the jaxpr tier.
+Re-pin: ``TPU_PAXOS_SHARD_PIN=1`` for the certificate,
+``TPU_PAXOS_SHARD_BUDGET_PIN=1`` for the budget (both under the
+make audit env so the host exposes the full 8-device grid); pinning
+refuses while ``TPU_PAXOS_SHARD_WEDGE`` arms a seeded regression.
+
+Import discipline: jax only inside :func:`run_shard_audit`; the
+rules/budget/certificate layer (``shard_rules``) and the partition
+table's matching logic are stdlib-only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpu_paxos.analysis import hlo_norm, shard_rules as shr, triage
+from tpu_paxos.analysis import registry as regm
+
+#: The committed virtual mesh grid.  Powers of two up to one host's
+#: ``--xla_force_host_platform_device_count=8`` (the make audit env);
+#: every shard_build/shard_parity geometry is sized to divide 8.
+MESH_GRID = (1, 2, 4, 8)
+
+
+def _wedge() -> str:
+    """The armed seeded-regression wedge ('' = none)."""
+    w = os.environ.get(shr.WEDGE_ENV, "")
+    if w and w not in shr.WEDGES:
+        raise ValueError(
+            f"unknown {shr.WEDGE_ENV} value {w!r} — one of "
+            f"{', '.join(shr.WEDGES)}"
+        )
+    return w
+
+
+def usable_grid(grid=MESH_GRID) -> tuple:
+    """The grid shapes this host can actually build (virtual devices
+    come from --xla_force_host_platform_device_count; a bare
+    interpreter may expose only 1)."""
+    import jax
+
+    n = len(jax.devices())
+    return tuple(g for g in grid if g <= n)
+
+
+def run_shard_audit(
+    providers=regm.AUDIT_PROVIDERS,
+    budget_path: str | None = shr.DEFAULT_BUDGET,
+    cert_path: str | None = shr.DEFAULT_CERT,
+    pin: bool = False,
+    pin_budget: bool = False,
+    triage_dir: str = "stress-triage",
+    grid=MESH_GRID,
+) -> dict:
+    """Run the four SH contracts over the registered entries; returns
+    a JSON-ready report (``ok`` iff coverage clean AND budget clean /
+    unenforceable AND parity clean).  ``pin`` re-pins the certificate
+    from the 1-device runs, ``pin_budget`` the per-mesh budget — both
+    refuse while a wedge is armed (the pin would enshrine the seeded
+    bug)."""
+    import jax
+
+    from tpu_paxos.analysis import hlo_audit
+    from tpu_paxos.parallel import mesh as pmesh
+    from tpu_paxos.parallel import partition_rules as prules
+
+    wedge = _wedge()
+    if (pin or pin_budget) and wedge:
+        raise regm.RegistryError(
+            f"shard-audit: refusing to pin with {shr.WEDGE_ENV}={wedge} "
+            "— the pin would enshrine the seeded bug"
+        )
+
+    backend = jax.default_backend()
+    jax_version = jax.__version__
+    entries = regm.collect(providers)
+    full = tuple(providers) == tuple(regm.AUDIT_PROVIDERS)
+    shapes = usable_grid(grid)
+    full_grid = full and tuple(shapes) == tuple(grid)
+    dumped: list[str] = []
+
+    # ---- SH301: partition-rule coverage over the stacked states ----
+    trees: dict = {}
+    for e in entries:
+        if e.shard_state is not None:
+            trees[e.name] = e.shard_state()
+    if wedge == "unruled-leaf":
+        import numpy as np
+
+        # a synthetic state family no table row covers — proves an
+        # unruled leaf fails loudly, named by path
+        trees["__wedge__"] = ("wedge", {"unruled": np.zeros((2, 2))})
+    cov = prules.coverage(trees)
+    if not full:
+        cov["stale_rules"] = []  # scoped runs never see every family
+    coverage_bad = bool(
+        cov["unmatched"] or cov["rank"] or cov["stale_rules"]
+    )
+
+    # ---- SH302 + SH303: per-mesh compile census --------------------
+    measured: dict = {}
+    texts: dict[str, str] = {}
+    grid_entries = [e for e in entries if e.shard_build is not None]
+    for e in grid_entries:
+        per_mesh: dict = {}
+        for n in shapes:
+            fn, args = e.shard_build(pmesh.make_instance_mesh(n))
+            lowerable = fn if hasattr(fn, "lower") else jax.jit(fn)
+            compiled = lowerable.lower(*args).compile()
+            text = compiled.as_text() or ""
+            census = shr.collective_census(
+                hlo_norm.opcode_histogram(text)
+            )
+            cell = {
+                "bytes_per_device": int(
+                    hlo_audit.memory_ceiling(compiled)["mem_bytes"]
+                ),
+                "collectives": census,
+            }
+            per_mesh[str(n)] = cell
+            texts[f"{e.name}@mesh{n}"] = text
+        measured[e.name] = per_mesh
+    if wedge == "undeclared-collective" and measured:
+        # inject one phantom collective at the largest shape of the
+        # first entry — the census must fail naming (entry, mesh, op)
+        name = sorted(measured)[0]
+        cell = measured[name][str(shapes[-1])]
+        cell["collectives"]["collective-permute"] += 1
+
+    budget = shr.load_budget(budget_path) if budget_path else {}
+    violations: list[dict] = []
+    stale: list[str] = []
+    enforced = False
+    if pin_budget:
+        path = budget_path or shr.DEFAULT_BUDGET
+        existing = shr.load_budget(path)
+        keep = None if full_grid else {
+            n: caps
+            for n, caps in sorted(existing.get("entries", {}).items())
+            if n not in measured and existing.get("backend") == backend
+        }
+        shr.save_budget(measured, path, backend, jax_version, keep=keep)
+    elif budget_path:
+        violations, stale, enforced = shr.check_budget(
+            measured, budget, backend, full_grid
+        )
+
+    # ---- SH304: cross-mesh parity ----------------------------------
+    results: dict = {}
+    for e in entries:
+        if e.shard_parity is None:
+            continue
+        results[e.name] = {
+            str(n): e.shard_parity(n) for n in shapes
+        }
+    if wedge == "parity-fork" and results:
+        # flip lane 0's verdict nibble at the largest multi-device
+        # shape of the first parity entry — the certificate must fail
+        # naming the first diverging (entry, mesh, lane)
+        name = sorted(results)[0]
+        forked = [n for n in shapes if n > 1]
+        if forked:
+            cell = results[name][str(forked[-1])]
+            v = cell["verdicts"]
+            cell["verdicts"] = (
+                format(int(v[0], 16) ^ 0x1, "x") + v[1:]
+            )
+    pinned_cert = shr.load_certificate(cert_path) if cert_path else {}
+    parity_failures: list[dict] = []
+    if pin:
+        ones = {
+            name: per_mesh["1"]
+            for name, per_mesh in sorted(results.items())
+            if "1" in per_mesh
+        }
+        # mesh invariance is still judged while pinning — a pin must
+        # not paper over a fork between shapes of THIS run
+        parity_failures = [
+            f for f in shr.check_certificate({}, results, full=False)
+            if f["mesh"] != 1
+        ]
+        if not parity_failures:
+            existing = shr.load_certificate(cert_path or shr.DEFAULT_CERT)
+            if not full:
+                for name, cert in sorted(
+                    existing.get("entries", {}).items()
+                ):
+                    ones.setdefault(name, cert)
+            shr.save_certificate(
+                ones, cert_path or shr.DEFAULT_CERT, backend, jax_version
+            )
+    elif cert_path:
+        parity_failures = shr.check_certificate(
+            pinned_cert, results, full=full_grid
+        )
+
+    # ---- triage dumps ----------------------------------------------
+    for v in violations:
+        key = f"{v['entry']}@mesh{v['mesh']}"
+        if key in texts:
+            try:
+                dumped.append(triage.write_dump(
+                    triage_dir, "shard", key, texts[key], ext="txt"
+                ))
+            except OSError:
+                pass  # read-only checkout must not mask the breach
+    for f in parity_failures:
+        name = f["entry"]
+        if name in results:
+            try:
+                dumped.append(triage.write_dump(
+                    triage_dir, "shard", name,
+                    json.dumps(results[name], indent=1, sort_keys=True),
+                    ext="json",
+                ))
+            except OSError:
+                pass
+
+    report = {
+        "version": 1,
+        "backend": backend,
+        "jax": jax_version,
+        "grid": list(shapes),
+        "grid_truncated": list(shapes) != list(grid),
+        "enforced": bool(enforced),
+        "wedge": wedge,
+        "coverage": cov,
+        "budget": {
+            "path": budget_path or "",
+            "pinned": bool(pin_budget),
+            "violations": violations,
+            "stale": stale,
+        },
+        "parity": {
+            "path": cert_path or "",
+            "pinned": bool(pin),
+            "entries": {
+                name: sorted(per_mesh, key=int)
+                for name, per_mesh in sorted(results.items())
+            },
+            "failures": parity_failures,
+        },
+        "dumped": sorted(set(dumped)),
+        "ok": not coverage_bad and not violations and not stale
+        and not parity_failures,
+    }
+    return report

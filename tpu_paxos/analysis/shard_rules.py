@@ -1,0 +1,331 @@
+"""shard-audit contracts + budget/certificate machinery (pure stdlib).
+
+The SH3xx rule documentation and every piece of the fifth tier that
+can be judged without jax: the collective census over an opcode
+histogram (``hlo_norm.opcode_histogram`` output — raw dumps re-judge
+in a jax-free image), the per-mesh replication/collective budget
+(``analysis/shard_budget.json``), and the cross-mesh parity
+certificate (``analysis/shard_certificate.json``).  The lowering /
+compiling / end-to-end-running half lives in ``shard_audit.py``,
+which owns the jax dependency; the committed partition-rule table
+itself is ``parallel/partition_rules.py`` (its matching logic is also
+jax-free on purpose).
+
+Budget semantics differ from the hlo tier where sharding makes the
+looser contract wrong:
+
+- **Collective counts are pinned EXACT, per mesh shape.**  Headroom
+  on a collective count would let an accidental extra all-reduce ride
+  inside the slack — but the committed SPMD story is "lanes are
+  independent; the only collectives are the sharded fast path's pmax
+  and psum", so the census is an equality, and a mismatch in EITHER
+  direction fails naming (entry, mesh, opcode).  A collective that
+  disappears is as suspicious as one that appears: it usually means
+  the tile stopped spanning the mesh.
+- **Per-device bytes get headroom** (allocator jitter is real), with
+  the hlo tier's looser memory pair.  The budget is per mesh shape:
+  the whole point of the tile is that per-device bytes FALL as the
+  mesh grows, and a flat curve (replication creep) must breach the
+  larger shapes' ceilings even when the 1-device number still fits.
+
+Certificates mirror ``mc_certificate.json``: the pin is the 1-device
+run (vmap semantics, no mesh), every other shape must reproduce it
+bitwise — per-lane verdict nibbles and per-lane decision-log sha256 —
+and drift fails naming the FIRST diverging (entry, mesh, lane).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: Rule ids -> one-line contracts (``--rules`` output; the long form
+#: is the shard_audit module doc).
+RULES = {
+    "SH301": "every array leaf of every registered stacked-state "
+             "pytree matches a committed partition rule "
+             "(parallel/partition_rules.py), and every rule matches "
+             "some leaf — unmatched leaves and stale rules fail by "
+             "pytree path / rule index",
+    "SH302": "per-device peak bytes of every shard_build entry stay "
+             "under the per-mesh-shape ceilings pinned in "
+             "analysis/shard_budget.json — replication creep breaches "
+             "the large-mesh ceilings first",
+    "SH303": "the collective census (all-reduce / all-gather / "
+             "collective-permute / reduce-scatter) of every compiled "
+             "entry equals the per-mesh-shape counts pinned in "
+             "analysis/shard_budget.json — exact, both directions",
+    "SH304": "per-lane verdict nibbles + decision-log sha256 of the "
+             "fleet drivers are bitwise identical across every mesh "
+             "shape and match analysis/shard_certificate.json — drift "
+             "names the first diverging (entry, mesh, lane)",
+}
+
+#: HLO collective families the census counts.  Async pairs fold into
+#: the base family via their ``-start`` half only (``-done`` retires
+#: the same collective; counting both would double it).
+COLLECTIVE_FAMILIES = (
+    "all-gather", "all-reduce", "collective-permute", "reduce-scatter",
+)
+
+DEFAULT_BUDGET = os.path.join(
+    os.path.dirname(__file__), "shard_budget.json"
+)
+DEFAULT_CERT = os.path.join(
+    os.path.dirname(__file__), "shard_certificate.json"
+)
+
+PIN_ENV = "TPU_PAXOS_SHARD_PIN"
+BUDGET_PIN_ENV = "TPU_PAXOS_SHARD_BUDGET_PIN"
+
+#: Seeded-regression switch (the PR-7 / modelcheck recall proof): each
+#: value arms ONE deliberate breach so the tier's failure path — and
+#: its naming — is tested, not assumed.  Pinning refuses while armed.
+WEDGE_ENV = "TPU_PAXOS_SHARD_WEDGE"
+WEDGES = ("unruled-leaf", "undeclared-collective", "parity-fork")
+
+#: Memory-ceiling caps (hlo tier's looser pair — allocator jitter).
+MEM_HEADROOM, MEM_SLACK = 0.3, 4096
+
+
+def collective_census(hist: dict) -> dict:
+    """Collective counts per family from an opcode histogram — sync
+    form plus the ``-start`` half of async pairs (see module doc)."""
+    out = {fam: 0 for fam in COLLECTIVE_FAMILIES}
+    for fam in COLLECTIVE_FAMILIES:
+        out[fam] = int(hist.get(fam, 0)) + int(hist.get(fam + "-start", 0))
+    return out
+
+
+# ---------------- budget (SH302 + SH303) ----------------
+
+def load_budget(path: str = DEFAULT_BUDGET) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_budget(measured: dict, path: str, backend: str,
+                jax_version: str, keep: dict | None = None) -> dict:
+    """Pin the measured grid: collective counts exact, bytes with
+    headroom.  ``measured`` is ``{entry: {mesh: {"bytes_per_device",
+    "collectives"}}}`` with string mesh keys; ``keep`` preserves
+    entries a scoped pin did not trace."""
+    entries = dict(keep or {})
+    for name, per_mesh in sorted(measured.items()):
+        entries[name] = {
+            mesh: {
+                "bytes_per_device": (
+                    int(m["bytes_per_device"] * (1 + MEM_HEADROOM))
+                    + MEM_SLACK
+                ),
+                "collectives": dict(sorted(m["collectives"].items())),
+            }
+            for mesh, m in sorted(per_mesh.items(), key=lambda kv: int(kv[0]))
+        }
+    data = {
+        "version": 1,
+        "backend": backend,
+        "jax": jax_version,
+        "mem_headroom": MEM_HEADROOM,
+        "mem_slack": MEM_SLACK,
+        "entries": dict(sorted(entries.items())),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def check_budget(measured: dict, budget: dict, backend: str,
+                 full_grid: bool) -> tuple[list[dict], list[str], bool]:
+    """-> (violations, stale, enforced).  Compiled text and allocator
+    numbers are backend-shaped, so nothing is enforced against a
+    budget pinned on a different backend (enforced=False) — the hlo
+    tier's gate.  On the pinning backend, unpinned (entry, mesh) cells
+    are violations (nothing stays uncapped), and pinned cells the run
+    no longer measures are stale — only when the run covered the full
+    registry AND the full mesh grid (``full_grid``)."""
+    entries: dict = budget.get("entries", {})
+    if budget and budget.get("backend") != backend:
+        return [], [], False
+    violations: list[dict] = []
+    for name in sorted(measured):
+        pinned_meshes = entries.get(name, {})
+        for mesh in sorted(measured[name], key=int):
+            m = measured[name][mesh]
+            caps = pinned_meshes.get(mesh)
+            if caps is None:
+                violations.append({
+                    "entry": name, "mesh": int(mesh), "key": "budget",
+                    "measured": None, "cap": None,
+                    "detail": (
+                        f"entry {name} mesh {mesh} has no pinned shard "
+                        f"budget — re-pin shard_budget.json "
+                        f"({BUDGET_PIN_ENV}=1)"
+                    ),
+                })
+                continue
+            got_b = int(m["bytes_per_device"])
+            cap_b = int(caps.get("bytes_per_device", 0))
+            if got_b > cap_b:
+                violations.append({
+                    "entry": name, "mesh": int(mesh),
+                    "key": "bytes_per_device",
+                    "measured": got_b, "cap": cap_b,
+                    "detail": (
+                        f"entry {name} mesh {mesh}: {got_b} bytes per "
+                        f"device > ceiling {cap_b} (+{got_b - cap_b}) "
+                        "— replication creep: state that should split "
+                        "over the mesh is being copied to every "
+                        "device; if intentional, re-pin "
+                        f"shard_budget.json ({BUDGET_PIN_ENV}=1)"
+                    ),
+                })
+            want_c = caps.get("collectives", {})
+            got_c = m["collectives"]
+            for fam in COLLECTIVE_FAMILIES:
+                w, g = int(want_c.get(fam, 0)), int(got_c.get(fam, 0))
+                if w != g:
+                    violations.append({
+                        "entry": name, "mesh": int(mesh), "key": fam,
+                        "measured": g, "cap": w,
+                        "detail": (
+                            f"entry {name} mesh {mesh}: {g} {fam} "
+                            f"in the compiled module, budget declares "
+                            f"exactly {w} — an undeclared collective "
+                            "(or a vanished one: the tile may have "
+                            "stopped spanning the mesh); if "
+                            "intentional, re-pin shard_budget.json "
+                            f"({BUDGET_PIN_ENV}=1)"
+                        ),
+                    })
+    stale: list[str] = []
+    if full_grid:
+        for name in sorted(entries):
+            for mesh in sorted(entries[name], key=int):
+                if mesh not in measured.get(name, {}):
+                    stale.append(f"{name}@mesh{mesh}")
+    return violations, stale, True
+
+
+# ---------------- certificate (SH304) ----------------
+
+def load_certificate(path: str = DEFAULT_CERT) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_certificate(entries: dict, path: str, backend: str,
+                     jax_version: str) -> dict:
+    """Pin per-entry ``{"verdicts", "lane_logs"}`` from the 1-device
+    canonical run (the vmap semantics every mesh must reproduce)."""
+    data = {
+        "version": 1,
+        "backend": backend,
+        "jax": jax_version,
+        "entries": {
+            name: {
+                "verdicts": e["verdicts"],
+                "lane_logs": list(e["lane_logs"]),
+            }
+            for name, e in sorted(entries.items())
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def first_divergence(ref: dict, got: dict):
+    """First lane where two parity results disagree ->
+    ``(lane, detail)`` or ``None``.  Lane order IS significance order:
+    the first diverging lane names the reproduction target."""
+    rv, gv = ref["verdicts"], got["verdicts"]
+    rl, gl = list(ref["lane_logs"]), list(got["lane_logs"])
+    n = max(len(rv), len(gv), len(rl), len(gl))
+    for i in range(n):
+        a = rv[i] if i < len(rv) else "?"
+        b = gv[i] if i < len(gv) else "?"
+        if a != b:
+            return i, f"verdict nibble {a!r} != {b!r}"
+        la = rl[i] if i < len(rl) else "?"
+        lb = gl[i] if i < len(gl) else "?"
+        if la != lb:
+            return i, (
+                f"decision-log sha256 {la[:12]}… != {lb[:12]}…"
+            )
+    return None
+
+
+def check_certificate(pinned: dict, results: dict,
+                      full: bool) -> list[dict]:
+    """SH304 judgment.  ``results`` is ``{entry: {mesh:
+    {"verdicts", "lane_logs"}}}`` (string mesh keys, "1" always
+    present).  Two comparisons per entry: every mesh against its OWN
+    mesh-1 run (mesh invariance — judged even with nothing pinned),
+    then mesh-1 against the pinned certificate (history).  Failures
+    name the first diverging (entry, mesh, lane)."""
+    failures: list[dict] = []
+    pe: dict = pinned.get("entries", {})
+    for name in sorted(results):
+        per_mesh = results[name]
+        ref = per_mesh.get("1")
+        if ref is None:
+            continue
+        for mesh in sorted(per_mesh, key=int):
+            if mesh == "1":
+                continue
+            div = first_divergence(ref, per_mesh[mesh])
+            if div is not None:
+                lane, detail = div
+                failures.append({
+                    "entry": name, "mesh": int(mesh), "lane": lane,
+                    "detail": (
+                        f"entry {name}: mesh {mesh} diverges from the "
+                        f"1-device run at lane {lane} ({detail}) — "
+                        "the tile changed lane semantics; lanes must "
+                        "be mesh-invariant"
+                    ),
+                })
+        cert = pe.get(name)
+        if cert is None:
+            failures.append({
+                "entry": name, "mesh": 1, "lane": None,
+                "detail": (
+                    f"entry {name} has no pinned parity certificate — "
+                    f"re-pin shard_certificate.json ({PIN_ENV}=1)"
+                ),
+            })
+            continue
+        div = first_divergence(cert, ref)
+        if div is not None:
+            lane, detail = div
+            failures.append({
+                "entry": name, "mesh": 1, "lane": lane,
+                "detail": (
+                    f"entry {name}: the 1-device run drifted from the "
+                    f"pinned certificate at lane {lane} ({detail}) — "
+                    "lane behavior changed; if intentional, re-pin "
+                    f"shard_certificate.json ({PIN_ENV}=1)"
+                ),
+            })
+    if full:
+        for name in sorted(set(pe) - set(results)):
+            failures.append({
+                "entry": name, "mesh": None, "lane": None,
+                "detail": (
+                    f"certificate entry {name} is pinned but no "
+                    "registered entry produces it — stale pin; re-pin "
+                    f"shard_certificate.json ({PIN_ENV}=1)"
+                ),
+            })
+    return failures

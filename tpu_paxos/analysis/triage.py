@@ -32,7 +32,7 @@ RETENTION_CAP = 32
 #: (analysis/modelcheck.py), whose deterministic scenario-index names
 #: make repeat runs overwrite.  Stress-sweep repro artifacts
 #: (``repro_*``) never match.
-DUMP_PREFIXES = ("jaxpr_", "hlo_", "mc_")
+DUMP_PREFIXES = ("jaxpr_", "hlo_", "mc_", "shard_")
 
 _SAFE = re.compile(r"[^A-Za-z0-9_]")
 

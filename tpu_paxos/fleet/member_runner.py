@@ -165,6 +165,7 @@ class MemberFleetRunner:
         max_episodes: int = frun.MAX_EPISODES,
         crash_rate: int = 0,
         max_rounds: int = 2000,
+        mesh=None,
     ):
         self.n = n_nodes
         self.i = n_instances
@@ -173,6 +174,7 @@ class MemberFleetRunner:
         self.max_episodes = int(max_episodes)
         self.crash_rate = int(crash_rate)
         self.max_rounds = int(max_rounds)
+        self.mesh = mesh
         round_fn = meng._build_round(
             n_nodes, n_instances, self.c, crash_rate,
             runtime_schedule=True,
@@ -190,7 +192,22 @@ class MemberFleetRunner:
 
         # the shared initial state broadcasts (in_axes=None): the [I]-
         # sized arrays upload once, not per lane
-        self._fn = jax.jit(jax.vmap(lane, in_axes=(0, None, 0, 0)))
+        fl = jax.vmap(lane, in_axes=(0, None, 0, 0))
+        if mesh is not None and mesh.size > 1:
+            from tpu_paxos.parallel import mesh as pmesh
+
+            # lane-axis tile, same shape as the sim fleet's: the
+            # broadcast initial state stays replicated (every device
+            # vmaps its lane block over the same st0); lane-stacked
+            # roots/tables/outputs split on the leading lane axis
+            # (SH001: the specs come from parallel/, never hand-built)
+            spec = pmesh.instance_spec(mesh)
+            fl = pmesh.shard_map(
+                fl, mesh,
+                in_specs=(spec, pmesh.replicated_spec(), spec, spec),
+                out_specs=spec,
+            )
+        self._fn = jax.jit(fl)
 
     def run(self, seeds, churns, schedules) -> MemberFleetReport:
         """One fleet dispatch: ``seeds[i]``, ``churns[i]``
@@ -203,6 +220,10 @@ class MemberFleetRunner:
         n_lanes = len(seeds)
         if len(churns) != n_lanes or len(schedules) != n_lanes:
             raise ValueError("one churn + one schedule per lane required")
+        if self.mesh is not None and n_lanes % max(self.mesh.size, 1):
+            raise ValueError(
+                f"{n_lanes} lanes do not tile over {self.mesh.size} devices"
+            )
         for s in schedules:
             meng._check_member_schedule(s)
         # the capacity proof is the single-run engine's, applied per
@@ -252,12 +273,10 @@ def audit_entries():
     from tpu_paxos.analysis.registry import AuditEntry
     from tpu_paxos.core import faults as fltm
 
-    def build():
-        n, i = 3, 8
-        runner = MemberFleetRunner(
-            n, i, max_events=4, max_episodes=2, crash_rate=500,
-            max_rounds=64,
-        )
+    def _scenarios(n_lanes):
+        """The two canonical (churn, schedule) pairs, cycled over the
+        lane count — distinct adjacent lanes so the mesh tiles never
+        see a uniform fleet."""
         churns = [
             ctm.ChurnSchedule((
                 ctm.ChurnEvent(vid=100),
@@ -279,19 +298,84 @@ def audit_entries():
             fltm.FaultSchedule((fltm.pause(2, 5, 1),)),
             fltm.FaultSchedule((fltm.crash(8, 2),)),
         ]
+        return (
+            [churns[i % 2] for i in range(n_lanes)],
+            [scheds[i % 2] for i in range(n_lanes)],
+        )
+
+    def _runner(mesh=None):
+        return MemberFleetRunner(
+            3, 8, max_events=4, max_episodes=2, crash_rate=500,
+            max_rounds=64, mesh=mesh,
+        )
+
+    def _setup(mesh=None, n_lanes=2):
+        n = 3
+        runner = _runner(mesh)
+        churns, scheds = _scenarios(n_lanes)
         ctabs = jax.tree.map(
             jnp.asarray, ctm.encode_churn_batch(churns, n, 4)
         )
         ftabs = jax.tree.map(
             jnp.asarray, stm.encode_batch(scheds, n, 2)
         )
-        roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
-        st0 = meng._init(n, i, runner.c)
+        roots = jnp.stack([prng.root_key(s) for s in range(n_lanes)])
+        st0 = meng._init(n, 8, runner.c)
         return runner._fn, (roots, st0, ctabs, ftabs)
+
+    def build():
+        return _setup()
+
+    def shard_build(mesh):
+        # 8 lanes tile every shape of the committed mesh grid; the
+        # canonical 2-lane trace stays the jaxpr/hlo-budget anchor
+        return _setup(mesh=mesh, n_lanes=8)
+
+    def shard_state():
+        # st0 broadcasts under the fleet vmap (in_axes=None); the
+        # SH301 tree is the lane-stacked view the tile actually maps,
+        # so stack it to the canonical 2-lane shape here
+        _, args = _setup()
+        st0 = args[1]
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (2,) + x.shape), st0
+        )
+        return "member", stacked
+
+    def shard_parity(n_devices):
+        import hashlib
+
+        from tpu_paxos.parallel import mesh as pmesh
+
+        mesh = (
+            pmesh.make_instance_mesh(n_devices) if n_devices > 1 else None
+        )
+        runner = _runner(mesh)
+        churns, scheds = _scenarios(8)
+        rep = runner.run(list(range(8)), churns, scheds)
+        v = rep.verdict
+        verdicts = "".join(
+            format(
+                (int(bool(v.quorum[i])) << 3)
+                | (int(bool(v.catchup[i])) << 2)
+                | (int(bool(v.coverage[i])) << 1)
+                | int(bool(v.completed[i])),
+                "x",
+            )
+            for i in range(rep.n_lanes)
+        )
+        logs = [
+            hashlib.sha256(rep.lane_log(i).encode()).hexdigest()
+            for i in range(rep.n_lanes)
+        ]
+        return {"verdicts": verdicts, "lane_logs": logs}
 
     return [
         AuditEntry(
             "member.fleet_lanes", build,
             covers=("MemberFleetRunner.__init__",), hlo_golden=True,
+            shard_build=shard_build,
+            shard_state=shard_state,
+            shard_parity=shard_parity,
         ),
     ]

@@ -264,11 +264,11 @@ class FleetRunner:
 
         fl = jax.vmap(lane)
         if mesh is not None and mesh.size > 1:
-            from jax.sharding import PartitionSpec as P
-
             from tpu_paxos.parallel import mesh as pmesh
 
-            spec = P(pmesh.instance_axes(mesh))
+            # lane-axis spec from the mesh module (SH001: axis names
+            # route through parallel/, never hand-built here)
+            spec = pmesh.instance_spec(mesh)
             fl = pmesh.shard_map(
                 fl, mesh,
                 in_specs=(spec,) * (7 if telemetry else 6),
@@ -585,38 +585,49 @@ def audit_entries():
     from tpu_paxos.core import faults as fltm
     from tpu_paxos.core.sim import audit_canonical_cfg
 
-    def _build(telemetry: bool):
+    def _audit_cfg():
         import dataclasses as dc
 
-        cfg = dc.replace(
+        return dc.replace(
             audit_canonical_cfg(),
             faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
         )
-        workload = simm.default_workload(cfg)
-        runner = FleetRunner(
-            cfg, workload, max_episodes=2, telemetry=telemetry
-        )
-        scheds = [
+
+    def _audit_scheds(n_lanes: int):
+        """The canonical 2-lane episode mix, cycled over ``n_lanes``
+        (same program whatever the lane count)."""
+        base = [
             fltm.FaultSchedule((fltm.partition(2, 6, (0,), (1, 2)),)),
             fltm.FaultSchedule((
                 fltm.pause(1, 4, 1), fltm.gray(2, 5, 2, delay=2),
             )),
         ]
+        return [base[i % 2] for i in range(n_lanes)]
+
+    def _build(telemetry: bool, mesh=None, n_lanes: int = 2):
+        cfg = _audit_cfg()
+        workload = simm.default_workload(cfg)
+        runner = FleetRunner(
+            cfg, workload, max_episodes=2, telemetry=telemetry,
+            mesh=mesh,
+        )
+        scheds = _audit_scheds(n_lanes)
         tabs = jax.tree.map(
             jnp.asarray, stm.encode_batch(scheds, cfg.n_nodes, 2)
         )
-        roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
+        roots = jnp.stack([prng.root_key(s) for s in range(n_lanes)])
         # one scalar mix + one per-edge WAN matrix: both normalize to
         # [lanes, A, A] matrix knobs — the envelope's one program
         from tpu_paxos.config import EdgeFaultConfig as _E
 
+        mixes = [cfg.faults, FaultConfig(
+            max_delay=2,
+            edges=_E.uniform(cfg.n_nodes, dup_rate=1000, max_delay=1),
+        )]
         kn, _ = runner._knob_arrays(
-            2, [cfg.faults, FaultConfig(
-                max_delay=2,
-                edges=_E.uniform(cfg.n_nodes, dup_rate=1000, max_delay=1),
-            )]
+            n_lanes, [mixes[i % 2] for i in range(n_lanes)]
         )
-        pend, gate, tail, exp, own, _ = runner._queues(2, None)
+        pend, gate, tail, exp, own, _ = runner._queues(n_lanes, None)
         states = runner._init(
             jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), roots
         )
@@ -627,9 +638,60 @@ def audit_entries():
         )
         if telemetry:
             args = args + (
-                jnp.zeros((2, cfg.n_nodes), jnp.int32),
+                jnp.zeros((n_lanes, cfg.n_nodes), jnp.int32),
             )
         return runner._fn, args
+
+    def shard_build(mesh):
+        # 8 lanes tile the whole {1, 2, 4, 8} grid; the lane program
+        # is the mesh=None one — only the tiling changes
+        return _build(False, mesh=mesh, n_lanes=8)
+
+    def shard_state():
+        # the [lanes]-stacked SimState the partition table must cover
+        _fn, args = _build(False)
+        return "fleet", args[1]
+
+    def shard_parity(n_devices: int):
+        """SH304: one fleet dispatch per mesh shape — per-lane verdict
+        nibbles (ok|agreement|coverage|quiescent) + decision-log
+        sha256 must be bitwise mesh-invariant (lanes are independent;
+        jax_threefry_partitionable makes tiled draws equal vmapped
+        draws — the PR-4/5 parity argument, certified per mesh)."""
+        import hashlib
+
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.replay.decision_log import decision_log
+
+        mesh = (
+            pmesh.make_instance_mesh(n_devices) if n_devices > 1 else None
+        )
+        cfg = _audit_cfg()
+        workload = simm.default_workload(cfg)
+        runner = FleetRunner(cfg, workload, max_episodes=2, mesh=mesh)
+        rep = runner.run(list(range(8)), _audit_scheds(8))
+        v = rep.verdict
+        verdicts = "".join(
+            format(
+                (int(bool(v.ok[i])) << 3)
+                | (int(bool(v.agreement[i])) << 2)
+                | (int(bool(v.coverage[i])) << 1)
+                | int(bool(v.quiescent[i])),
+                "x",
+            )
+            for i in range(rep.n_lanes)
+        )
+        met = rep.final.met
+        stride = runner.vid_bound  # covers every canonical vid
+        logs = [
+            hashlib.sha256(decision_log(
+                np.asarray(met.chosen_vid[i]),
+                np.asarray(met.chosen_ballot[i]),
+                stride, cfg.n_instances,
+            ).encode()).hexdigest()
+            for i in range(rep.n_lanes)
+        ]
+        return {"verdicts": verdicts, "lane_logs": logs}
 
     ir204_why = (
         "the vmapped lane body IS core/sim's round_fn — same "
@@ -640,6 +702,9 @@ def audit_entries():
             "fleet.run_lanes", lambda: _build(False),
             covers=("FleetRunner.__init__",),
             allow=("IR204",), why=ir204_why, hlo_golden=True,
+            shard_build=shard_build,
+            shard_state=shard_state,
+            shard_parity=shard_parity,
         ),
         AuditEntry(
             # the telemetry-armed twin: recorder accumulators (incl.

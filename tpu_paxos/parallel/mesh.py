@@ -37,13 +37,46 @@ INSTANCE_AXIS = "i"
 DCN_AXIS = "dcn"
 
 
+def _spec_axes(specs) -> set:
+    """Mesh axis names referenced by any ``PartitionSpec`` leaf of a
+    spec pytree (a spec dim is an axis name or a tuple of names)."""
+    names: set = set()
+    for leaf in jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)):
+        if not isinstance(leaf, P):
+            continue
+        for dim in leaf:
+            if dim is None:
+                continue
+            dims = dim if isinstance(dim, (tuple, list)) else (dim,)
+            names.update(str(d) for d in dims)
+    return names
+
+
 def shard_map(f, mesh: Mesh, in_specs, out_specs):
     """Version-portable ``shard_map`` without replication checking
     (the round functions assert their own replication invariants; the
     checker's conservative analysis rejects the cond-gated
     collectives).  New jax exposes ``jax.shard_map(check_vma=...)``;
     older releases only have the experimental module with
-    ``check_rep``."""
+    ``check_rep``.
+
+    Specs are validated against the mesh up front: jax's own error
+    for an axis name absent from the mesh surfaces deep in lowering
+    without naming the spec (and with replication checking off some
+    versions silently treat the dim as replicated) — exactly the gap
+    a mesh-polymorphic caller reusing a spec built for a different
+    mesh would fall into.  Rejection is BY NAME (pinned by
+    tests/test_shard_audit.py)."""
+    unknown = sorted(
+        _spec_axes((in_specs, out_specs)) - set(mesh.axis_names)
+    )
+    if unknown:
+        raise ValueError(
+            f"shard_map spec names mesh axis {unknown[0]!r} but the "
+            f"mesh has axes {tuple(mesh.axis_names)} — build specs "
+            "from this mesh (parallel/mesh.instance_spec or "
+            "parallel/partition_rules.tree_spec), not another's"
+        )
     if hasattr(jax, "shard_map"):
         return jax.shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,

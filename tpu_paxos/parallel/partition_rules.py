@@ -1,0 +1,246 @@
+"""Committed partition-rule table — the one source of truth for how
+state pytrees lay out over the device mesh.
+
+The SPMD surfaces of this repo shard exactly two ways: protocol
+arrays split over the *instance* axis (the sharded engines,
+``parallel/sharded.py`` / ``parallel/sharded_sim.py``), and fleet
+state stacked over a leading *lane* axis that tiles over devices
+(``fleet/runner.py``, ``fleet/member_runner.py``, ``serve/fleet.py``).
+Before this table, each call site hand-built its ``PartitionSpec``
+pytree — so a new state leaf silently inherited whatever the closest
+copy-paste said (usually: fully replicated), and nothing could audit
+the decision.  Now the layout is *data*: ``RULES`` maps a regex over
+the leaf's pytree path (``<family>/<field>/<field>`` — the snippet
+exemplar's ``match_partition_rules`` pattern) to a dims template, the
+engines derive their spec pytrees from it (:func:`tree_spec`), and
+the shard-audit tier (``analysis/shard_audit.py``, SH301) holds it to
+two contracts: every array leaf of every registered stacked-state
+pytree must match some rule (an unmatched leaf fails BY PATH — it
+would otherwise replicate silently), and every rule must match some
+leaf (a rule matching nothing is stale and fails too).
+
+Dims language (first matching rule wins, scalars are free):
+
+- ``REP`` — fully replicated at any rank (``PartitionSpec()``).
+- a tuple of per-dimension entries, each ``None`` (unsharded) or
+  ``LANE`` (split over the mesh's lane/instance axes — substituted
+  with the actual axis-name tuple at spec-build time, so one rule
+  serves the 1-D ``('i',)`` and 2-D ``('dcn', 'i')`` meshes alike).
+  A trailing ``...`` means "any remaining dims, unsharded"; without
+  it the tuple length must equal the leaf's rank exactly, so a rule
+  drifting from the state layout it was written for fails loudly
+  instead of sharding the wrong dimension.
+- rank-0 and single-element leaves need no rule: they are replicated
+  wherever they live (the snippet-[1] scalar case).
+
+Import discipline: the table and the matching logic are pure stdlib;
+jax is imported only inside the spec-building/coverage functions, so
+the jax-free analysis layer (``analysis/shard_rules.py``) can read
+and document the rules without pulling the runtime.
+"""
+
+from __future__ import annotations
+
+import re
+
+#: Dim sentinel: split this dimension over the mesh's lane/instance
+#: axes (``parallel/mesh.instance_axes`` — ``('i',)`` or
+#: ``('dcn', 'i')``).
+LANE = "lane"
+
+#: Dims sentinel: fully replicated at any rank.
+REP = "replicated"
+
+#: The committed table: (path regex, dims).  Ordered — the FIRST
+#: matching rule wins, so family catch-alls (``^sim/prop/``) sit
+#: below the sharded leaves they would otherwise swallow.
+RULES: tuple = (
+    # ---- fast: parallel/sharded.py FastState ([A, I] SoA) ----------
+    # per-acceptor scalars replicate; protocol arrays split on the
+    # minor instance axis (core/fast.py's layout note)
+    (r"^fast/(promised|max_seen)$", REP),
+    (r"^fast/(acc_ballot|acc_vid|learned)$", (None, LANE)),
+    # ---- sim: parallel/sharded_sim.py global SimState --------------
+    (r"^sim/acc/(promised|max_seen)$", REP),
+    (r"^sim/acc/(acc_ballot|acc_vid)$", (None, LANE)),
+    (r"^sim/learned$", (None, LANE)),
+    (r"^sim/prop/(adopted_b|adopted_v|cur_batch|own_assign|commit_vid)$",
+     (None, LANE)),
+    (r"^sim/prop/(acks|commit_acked)$", (None, None, LANE)),
+    # per-shard private queues: leading axis = shard
+    (r"^sim/prop/(pend|gate)$", (LANE, None, None)),
+    (r"^sim/prop/(head|tail)$", (LANE, None)),
+    # [P]/[P, A] proposer control plane: replicated (updates are
+    # functions of replicated arrivals + the global reductions)
+    (r"^sim/prop/", REP),
+    (r"^sim/net/", REP),  # network calendars: replicated
+    (r"^sim/met/chosen_(vid|round|ballot)$", (LANE,)),
+    (r"^sim/met/msgs$", REP),
+    (r"^sim/(crashed|qsums)$", REP),
+    # ---- lane-stacked fleets: leading lane axis tiles the mesh,
+    # everything behind it is lane-local (lanes are independent — the
+    # cross-mesh parity basis the shard audit certifies) ------------
+    (r"^fleet/", (LANE, ...)),
+    (r"^member/", (LANE, ...)),
+    (r"^serve/", (LANE, ...)),
+)
+
+
+class PartitionRuleError(ValueError):
+    """A stacked-state leaf no committed rule matches (named by pytree
+    path), or a matched rule whose rank disagrees with the leaf."""
+
+
+def _key_part(key) -> str:
+    """One pytree path key as a path segment: attribute name for
+    NamedTuple/dataclass fields, index for sequences, key for dicts."""
+    for attr in ("name", "idx", "key"):
+        v = getattr(key, attr, None)
+        if v is not None:
+            return str(v)
+    return str(key)
+
+
+def leaf_path(family: str, path) -> str:
+    """``family/part/part`` path string for one flattened leaf."""
+    return "/".join([family, *(_key_part(k) for k in path)])
+
+
+def is_trivial(leaf) -> bool:
+    """Rank-0 / single-element leaves need no rule: they replicate
+    wherever they live."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    if not shape:
+        return True
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n == 1
+
+
+def match_path(path: str):
+    """First matching rule for a leaf path -> ``(index, dims)`` or
+    ``None``.  Jax-free on purpose (the audit's SH301 docs and the
+    unit tests judge the table without the runtime)."""
+    for idx, (pat, dims) in enumerate(RULES):
+        if re.search(pat, path):
+            return idx, dims
+    return None
+
+
+def rank_problem(dims, ndim: int) -> str | None:
+    """Why ``dims`` cannot spec a rank-``ndim`` leaf (None = fine)."""
+    if dims == REP:
+        return None
+    fixed = [d for d in dims if d is not Ellipsis]
+    open_rank = len(fixed) != len(dims)
+    if open_rank:
+        if ndim < len(fixed):
+            return (
+                f"rule wants rank >= {len(fixed)} "
+                f"(dims {dims!r}), leaf has rank {ndim}"
+            )
+        return None
+    if ndim != len(dims):
+        return (
+            f"rule pins rank {len(dims)} (dims {dims!r}), leaf has "
+            f"rank {ndim} — the rule drifted from the state layout"
+        )
+    return None
+
+
+def spec_of(dims, axes):
+    """Build the ``PartitionSpec`` for a dims template; ``axes`` is
+    the mesh's lane/instance axis name (or tuple of names) that
+    ``LANE`` substitutes."""
+    from jax.sharding import PartitionSpec as P
+
+    if dims == REP:
+        return P()
+    out = []
+    for d in dims:
+        if d is Ellipsis:
+            break  # trailing dims unsharded: P() pads with None
+        out.append(axes if d == LANE else None)
+    return P(*out)
+
+
+def tree_spec(family: str, tree, axes):
+    """Spec pytree for ``tree`` derived from the committed table —
+    what the sharded engines feed ``shard_map`` / ``NamedSharding``.
+    Raises :class:`PartitionRuleError` naming the pytree path of any
+    leaf the table does not cover (a new state field must be ruled
+    before it can ship, which is SH301 enforced at runtime too)."""
+    import jax
+
+    def one(path, leaf):
+        if is_trivial(leaf):
+            return spec_of(REP, axes)
+        p = leaf_path(family, path)
+        hit = match_path(p)
+        if hit is None:
+            raise PartitionRuleError(
+                f"no committed partition rule matches leaf {p} "
+                f"(shape {tuple(leaf.shape)}) — add a rule to "
+                "parallel/partition_rules.py (SH301: an unruled leaf "
+                "would silently replicate)"
+            )
+        idx, dims = hit
+        problem = rank_problem(dims, len(leaf.shape))
+        if problem:
+            raise PartitionRuleError(
+                f"partition rule {RULES[idx][0]!r} matched leaf {p} "
+                f"but {problem}"
+            )
+        return spec_of(dims, axes)
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def coverage(trees: dict) -> dict:
+    """SH301 sweep over ``{entry_name: (family, state_pytree)}``:
+    match every array leaf, account which rules fired.  Returns a
+    JSON-ready dict — ``unmatched`` (leaves no rule covers, by pytree
+    path), ``rank`` (rule/leaf rank disagreements), ``stale_rules``
+    (rules matching no leaf of any registered tree: dead table rows
+    fail exactly like dead budget entries)."""
+    import jax
+
+    unmatched: list[dict] = []
+    rank_bad: list[dict] = []
+    used: set[int] = set()
+    leaves = 0
+    for entry in sorted(trees):
+        family, tree = trees[entry]
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            leaves += 1
+            if is_trivial(leaf):
+                continue
+            p = leaf_path(family, path)
+            hit = match_path(p)
+            if hit is None:
+                unmatched.append({
+                    "entry": entry, "path": p,
+                    "shape": [int(d) for d in leaf.shape],
+                })
+                continue
+            idx, dims = hit
+            used.add(idx)
+            problem = rank_problem(dims, len(getattr(leaf, "shape", ())))
+            if problem:
+                rank_bad.append({
+                    "entry": entry, "path": p,
+                    "rule": RULES[idx][0], "detail": problem,
+                })
+    stale = [
+        {"index": i, "rule": pat}
+        for i, (pat, _dims) in enumerate(RULES)
+        if i not in used
+    ]
+    return {
+        "rules": len(RULES),
+        "leaves": leaves,
+        "unmatched": unmatched,
+        "rank": rank_bad,
+        "stale_rules": stale,
+    }

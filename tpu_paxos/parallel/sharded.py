@@ -34,13 +34,24 @@ def _state_specs(axes=INSTANCE_AXIS) -> fast.FastState:
     """PartitionSpec pytree for FastState: [A, I] arrays split over
     the (minor) instance axis, [A] scalars replicated.  ``axes`` is
     the mesh axis name (or tuple of names, for the 2-D dcn x ici
-    multi-host mesh) sharding the instance dimension."""
+    multi-host mesh) sharding the instance dimension.  The dims come
+    from the committed partition-rule table
+    (parallel/partition_rules.py) — a FastState field the table does
+    not rule fails here by name (SH301's runtime twin)."""
+    from tpu_paxos.parallel import partition_rules as prules
+
+    def spec(field: str):
+        hit = prules.match_path(f"fast/{field}")
+        if hit is None:
+            raise prules.PartitionRuleError(
+                f"no committed partition rule matches leaf "
+                f"fast/{field} — add a rule to "
+                "parallel/partition_rules.py (SH301)"
+            )
+        return prules.spec_of(hit[1], axes)
+
     return fast.FastState(
-        promised=P(),
-        max_seen=P(),
-        acc_ballot=P(None, axes),
-        acc_vid=P(None, axes),
-        learned=P(None, axes),
+        **{f: spec(f) for f in fast.FastState._fields}
     )
 
 
@@ -121,8 +132,7 @@ def audit_entries():
     from tpu_paxos.analysis.registry import AuditEntry
     from tpu_paxos.parallel import mesh as pmesh
 
-    def build():
-        mesh = pmesh.make_instance_mesh(1)
+    def _setup(mesh):
         n = 16
         state = init_sharded_state(mesh, n, n_nodes=3)
         vids = pmesh.shard_instances(
@@ -130,6 +140,16 @@ def audit_entries():
         )
         return sharded_choose_all(mesh, proposer=0, quorum=2), (state, vids)
 
+    def build():
+        return _setup(pmesh.make_instance_mesh(1))
+
+    def shard_state():
+        # the [A, I] protocol state the partition table must cover
+        mesh = pmesh.make_instance_mesh(1)
+        return "fast", init_sharded_state(mesh, 16, n_nodes=3)
+
     return [AuditEntry("sharded.choose_all", build,
                        covers=("sharded_choose_all",),
-                       mesh_axes=(INSTANCE_AXIS,))]
+                       mesh_axes=(INSTANCE_AXIS,),
+                       shard_build=_setup,
+                       shard_state=shard_state)]

@@ -48,58 +48,19 @@ from tpu_paxos.parallel.mesh import INSTANCE_AXIS, instance_axes
 from tpu_paxos.utils import prng
 
 
-def _state_specs(axes=INSTANCE_AXIS) -> simm.SimState:
-    """PartitionSpec pytree for SimState under the instance mesh.
-    ``axes`` is the mesh axis name (or tuple of names for the 2-D
-    dcn x ici multi-host mesh) sharding the instance dimension."""
-    return simm.SimState(
-        t=P(),
-        acc=simm.AcceptorState(
-            promised=P(),
-            max_seen=P(),
-            acc_ballot=P(None, axes),
-            acc_vid=P(None, axes),
-        ),
-        learned=P(None, axes),
-        prop=simm.ProposerState(
-            mode=P(),
-            count=P(),
-            ballot=P(),
-            pmax_seen=P(),
-            delay_until=P(),
-            prep_deadline=P(),
-            prep_retries=P(),
-            promises=P(),
-            adopted_b=P(None, axes),
-            adopted_v=P(None, axes),
-            cur_batch=P(None, axes),
-            acks=P(None, None, axes),
-            acc_deadline=P(),
-            acc_retries=P(),
-            own_assign=P(None, axes),
-            # leading axis = shard (per-shard private queues)
-            pend=P(axes, None, None),
-            gate=P(axes, None, None),
-            head=P(axes, None),
-            tail=P(axes, None),
-            commit_vid=P(None, axes),
-            commit_acked=P(None, None, axes),
-            commit_deadline=P(),
-            stall=P(),
-            commit_wait=P(),
-        ),
-        net=jax.tree.map(lambda _: P(), simm.netm.init_buffers(1, 1, 1)),
-        met=simm.Metrics(
-            chosen_vid=P(axes),
-            chosen_round=P(axes),
-            chosen_ballot=P(axes),
-            msgs=P(),
-        ),
-        crashed=P(),
-        done=P(),
-        qsums=P(),  # post-collective: replicated
-        qhmax=P(),
-    )
+def _state_specs(st: simm.SimState, axes=INSTANCE_AXIS) -> simm.SimState:
+    """PartitionSpec pytree for a (global, queue-wrapped) SimState
+    under the instance mesh, derived PER LEAF from the committed
+    partition-rule table (parallel/partition_rules.py): [.., I]
+    protocol arrays split on the minor instance axis, the per-shard
+    queue leaves on their leading shard axis, [P]/[A] control plane
+    and calendars replicated.  ``axes`` is the mesh axis name (or
+    tuple of names for the 2-D dcn x ici multi-host mesh).  A state
+    leaf the table does not rule raises by pytree path — the runtime
+    twin of the shard audit's SH301."""
+    from tpu_paxos.parallel import partition_rules as prules
+
+    return prules.tree_spec("sim", st, axes)
 
 
 def _unwrap(st: simm.SimState) -> simm.SimState:
@@ -258,7 +219,7 @@ def init_sharded_state(
     )
     shardings = jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        _state_specs(instance_axes(mesh)),
+        _state_specs(st, instance_axes(mesh)),
         is_leaf=lambda x: isinstance(x, P),
     )
     return jax.tree.map(jax.device_put, st, shardings)
@@ -315,7 +276,7 @@ def build_runner(
 
         return _wrap(jax.lax.while_loop(cond, step, st))
 
-    specs = _state_specs(axes)
+    specs = _state_specs(state, axes)
     mapped = jax.jit(
         pmesh.shard_map(
             body,
@@ -364,11 +325,27 @@ def audit_entries():
     from tpu_paxos.analysis.registry import AuditEntry
     from tpu_paxos.core.sim import audit_canonical_cfg
 
-    def build():
+    def _setup(mesh):
         cfg = audit_canonical_cfg()
-        mesh = pmesh.make_instance_mesh(1)
         fn, root, state, _expected = build_runner(cfg, mesh)
         return fn, (root, state)
+
+    def build():
+        return _setup(pmesh.make_instance_mesh(1))
+
+    def shard_build(mesh):
+        # the canonical cfg's n_instances (16) divides the whole
+        # {1, 2, 4, 8} mesh grid — same program, reshaped
+        return _setup(mesh)
+
+    def shard_state():
+        # the global sharded SimState (queue leaves carry the leading
+        # shard axis) the partition table must cover leaf-for-leaf
+        cfg = audit_canonical_cfg()
+        _fn, _root, state, _expected = build_runner(
+            cfg, pmesh.make_instance_mesh(1)
+        )
+        return "sim", state
 
     return [AuditEntry(
         "sharded_sim.run_rounds", build,
@@ -377,4 +354,6 @@ def audit_entries():
         allow=("IR204",),
         why="same unique-key compaction sorts as sim.run_rounds (the "
             "shard_map body IS core/sim's round_fn)",
+        shard_build=shard_build,
+        shard_state=shard_state,
     )]

@@ -1282,11 +1282,11 @@ class ControlFleetRunner:
 
         fl = jax.vmap(lane)
         if mesh is not None and mesh.size > 1:
-            from jax.sharding import PartitionSpec as P
-
             from tpu_paxos.parallel import mesh as pmesh
 
-            spec = P(pmesh.instance_axes(mesh))
+            # lane-axis spec from the mesh module (SH001: axis names
+            # route through parallel/, never hand-built here)
+            spec = pmesh.instance_spec(mesh)
             fl = pmesh.shard_map(
                 fl, mesh, in_specs=(spec,) * 7, out_specs=(spec,) * 6
             )

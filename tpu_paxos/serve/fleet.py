@@ -244,11 +244,11 @@ class ServeFleetRunner:
 
         fl = jax.vmap(lane)
         if mesh is not None and mesh.size > 1:
-            from jax.sharding import PartitionSpec as P
-
             from tpu_paxos.parallel import mesh as pmesh
 
-            spec = P(pmesh.instance_axes(mesh))
+            # lane-axis spec from the mesh module (SH001: axis names
+            # route through parallel/, never hand-built here)
+            spec = pmesh.instance_spec(mesh)
             fl = pmesh.shard_map(
                 fl, mesh, in_specs=(spec,) * 6, out_specs=(spec,) * 6
             )
@@ -1036,10 +1036,10 @@ def audit_entries():
     from tpu_paxos.analysis.registry import AuditEntry
     from tpu_paxos.core.sim import audit_canonical_cfg
 
-    r_window, s_windows, k_admit, n_lanes = 8, 2, 4, 2
+    r_window, s_windows, k_admit = 8, 2, 4
     w_rounds = r_window * 4
 
-    def _setup():
+    def _setup(mesh=None, n_lanes=2):
         cfg = dataclasses.replace(
             audit_canonical_cfg(),
             faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
@@ -1048,14 +1048,14 @@ def audit_entries():
         v_bound = drv.vid_bound_of(workload)
         _, _, _, c = simm.prepare_queues(cfg, workload)
         runner = ServeFleetRunner(
-            cfg, c, v_bound, r_window, w_rounds
+            cfg, c, v_bound, r_window, w_rounds, mesh=mesh
         )
         p = len(cfg.proposers)
         width = c + cfg.assign_window
         pend = np.full((n_lanes, p, width), int(val.NONE), np.int32)
         gate = np.full((n_lanes, p, width), int(val.NONE), np.int32)
         tail = np.zeros((n_lanes, p), np.int32)
-        roots = jnp.stack([prng.root_key(s) for s in (0, 1)])
+        roots = jnp.stack([prng.root_key(s) for s in range(n_lanes)])
         sss = runner._init(
             jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), roots
         )
@@ -1092,6 +1092,53 @@ def audit_entries():
         fn, args = _setup()
         return fn, args, {}
 
+    def shard_build(mesh):
+        # 8 lanes tile every shape of the committed mesh grid; the
+        # canonical 2-lane trace stays the jaxpr/hlo-budget anchor
+        return _setup(mesh=mesh, n_lanes=8)
+
+    def shard_state():
+        # the [lanes]-stacked serve-loop state the partition table
+        # must cover (SH301); the leading lane axis is the sharded one
+        _, args = _setup()
+        return "serve", args[0]
+
+    def shard_parity(n_devices):
+        import hashlib
+
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.replay.decision_log import decision_log
+
+        mesh = (
+            pmesh.make_instance_mesh(n_devices) if n_devices > 1 else None
+        )
+        cfg = SimConfig(
+            n_nodes=3, n_instances=16, proposers=(0, 1), seed=0,
+            max_rounds=256,
+            faults=FaultConfig(drop_rate=500, max_delay=2),
+        )
+        lanes = fleet_lanes(cfg, 8, 6, 1500, 0)
+        rep = serve_fleet_run(
+            cfg, lanes,
+            rounds_per_window=r_window, windows_per_dispatch=s_windows,
+            admit_width=6, mesh=mesh,
+            slo=sh.ServeSLO(latency_rounds=16, budget_milli=100),
+        )
+        verdicts = "".join(
+            format(
+                (int(rep.decided[i]) == rep.n_values[i]) << 1
+                | int(bool(rep.breach[i])),
+                "x",
+            )
+            for i in range(rep.n_lanes)
+        )
+        logs = []
+        for i in range(rep.n_lanes):
+            cv, cb = rep.lane_chosen(i)
+            text = decision_log(cv, cb, stride=30, n_instances=len(cv))
+            logs.append(hashlib.sha256(text.encode()).hexdigest())
+        return {"verdicts": verdicts, "lane_logs": logs}
+
     ir204_why = (
         "the vmapped window body IS core/sim's round_fn — same "
         "unique-key compaction sorts as sim.run_rounds"
@@ -1104,6 +1151,9 @@ def audit_entries():
             donate_argnums=(0,),
             hlo_build=hlo_build,
             hlo_golden=True,
+            shard_build=shard_build,
+            shard_state=shard_state,
+            shard_parity=shard_parity,
         ),
     ]
 
