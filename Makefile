@@ -6,9 +6,9 @@ PY ?= python
 
 .PHONY: test test-slow check lint lint-json audit audit-json \
 	shard-audit bench bench-sharded parity parity-fast replay-diff \
-	replay-diff-member run stress stress-quick fleet fleet-quick mc \
-	mc-quick serve serve-quick serve-fleet serve-fleet-quick \
-	serve-control serve-control-quick clean
+	replay-diff-member run stress stress-quick fleet fleet-quick \
+	evolve evolve-quick mc mc-quick serve serve-quick serve-fleet \
+	serve-fleet-quick serve-control serve-control-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -69,7 +69,7 @@ shard-audit:
 # un-jitted op-by-op smoke of one tiny config per engine (every cond
 # predicate, slice bound, and dtype materializes eagerly).  The pallas
 # interpreter path is part of the fast tier (tests/test_fastwin.py).
-check: lint audit shard-audit mc-quick serve-quick serve-fleet-quick serve-control-quick
+check: lint audit shard-audit mc-quick evolve-quick serve-quick serve-fleet-quick serve-control-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
@@ -131,6 +131,26 @@ fleet:
 # in one short run.
 fleet-quick:
 	$(PY) -m tpu_paxos fleet --lanes 8 --generations 1 --seed 2 \
+	  --decision-round-max 35 --max-wedges 1 --triage-dir stress-triage
+
+# Certified selection loop (tpu_paxos/fleet/evolve.py): mutate-and-
+# select over fault-schedule / churn / offered-load genomes, one
+# fleet dispatch per generation through the shared envelope cache
+# (zero warm compiles after gen 0, census-pinned).  --certified reads
+# the lane budget from the mc certificate (quick scope / 4) and
+# withholds the bench record unless the shrunk artifact replays
+# byte-identically inside it.  AXIS=fleet|member|serve, HUNT=<cause>.
+evolve:
+	$(PY) -m tpu_paxos evolve --axis $(or $(AXIS),fleet) \
+	  --lanes $(or $(LANES),8) --generations $(or $(GENS),8) \
+	  $(if $(HUNT),--hunt $(HUNT)) --triage-dir stress-triage
+
+# Quick pass (wired into make check): the synthetic
+# decision_round_max wedge knob armed, so sample -> select -> flag ->
+# shrink -> artifact -> replay is exercised end to end in one short
+# run (same knob and seed discipline as fleet-quick).
+evolve-quick:
+	$(PY) -m tpu_paxos evolve --lanes 8 --generations 2 --seed 2 \
 	  --decision-round-max 35 --max-wedges 1 --triage-dir stress-triage
 
 # Exhaustive bounded model checking (tpu_paxos/analysis/modelcheck.py):

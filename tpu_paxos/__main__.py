@@ -596,6 +596,13 @@ def main(argv=None) -> int:
         from tpu_paxos.fleet import search as fsearch
 
         return fsearch.main(argv[1:])
+    if argv and argv[0] == "evolve":
+        # mutate-and-select wedge hunting: evolve fault/churn/load
+        # genomes over fleet lanes, certified recall against the mc
+        # certificate's exhaustive denominator
+        from tpu_paxos.fleet import evolve as fevolve
+
+        return fevolve.main(argv[1:])
     if argv and argv[0] == "mc":
         # exhaustive bounded model checking: enumerate a declared
         # scope's full scenario cross product as chunked fleet lanes,

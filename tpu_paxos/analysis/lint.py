@@ -48,6 +48,7 @@ REPLAY_ROOTS = (
     "tpu_paxos.membership",
     "tpu_paxos.replay",
     "tpu_paxos.harness.shrink",
+    "tpu_paxos.fleet.evolve",
 )
 
 _PRAGMA_RE = re.compile(r"#\s*paxlint:\s*allow\[([A-Za-z0-9_*,\s]+)\]")

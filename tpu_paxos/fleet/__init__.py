@@ -8,8 +8,8 @@ search stack (which imports the harness).
 """
 
 _SUBMODULES = (
-    "envelope", "member_runner", "runner", "schedule_table", "search",
-    "verdict",
+    "envelope", "evolve", "member_runner", "runner", "schedule_table",
+    "search", "verdict",
 )
 
 

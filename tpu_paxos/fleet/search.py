@@ -34,6 +34,8 @@ convergence-latency hunting, but not a correctness failure).
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import importlib
 import json
 import os
 import sys
@@ -67,6 +69,121 @@ GENE_LAT_MAX = 4
 #: checker's (node, round)-grid discipline, analysis/modelcheck.py —
 #: late crash points mostly land after convergence and waste draws).
 CRASH_GRID = 8
+
+#: Churn-event injection-round grid (``sample_churn_schedule``): t0
+#: draws quantize to this many slots, the churn checker's t0_grid
+#: discipline (analysis/mc_member.py).
+CHURN_T0_GRID = 8
+
+#: Plain-value vid base for churn-schedule draws.  Must stay equal to
+#: ``analysis/mc_member.PLAIN_VID_BASE`` (pinned by test) — the
+#: sampler cannot import the checker (the checker lives outside the
+#: replay-critical DET closure this module is inside).
+CHURN_PLAIN_VID_BASE = 100
+
+
+@dataclasses.dataclass(frozen=True)
+class Alphabet:
+    """The declarative search-grammar spec, shared by ``search`` and
+    ``fleet/evolve`` so the two samplers cannot drift: which episode
+    kinds are drawable (in DRAW ORDER — reordering changes every
+    seeded draw sequence), whether per-edge WAN fault matrices are
+    genes, and the schedule-shape bounds.
+
+    ``classic()`` reproduces the historical ``--gray``/``--wan``
+    booleans exactly: the kinds tuples are the committed ``KINDS`` /
+    ``KINDS_GRAY`` objects, so every seeded draw sequence — and the
+    committed fleet-quick wedge artifact pinned against the classic
+    grammar — is unchanged."""
+
+    kinds: tuple = KINDS
+    wan: bool = False
+    max_episodes: int = 4
+    horizon: int = 96
+
+    def __post_init__(self) -> None:
+        if not self.kinds:
+            raise ValueError("alphabet needs at least one episode kind")
+        bad = sorted(set(self.kinds) - set(KINDS_GRAY))
+        if bad:
+            raise ValueError(
+                f"unknown episode kind(s): {', '.join(bad)} "
+                f"(drawable: {', '.join(KINDS_GRAY)})"
+            )
+        if len(set(self.kinds)) != len(self.kinds):
+            raise ValueError("alphabet kinds must be distinct")
+        if self.max_episodes < 1:
+            raise ValueError("max_episodes must be >= 1")
+        if self.horizon < 8:
+            raise ValueError("horizon must be >= 8 rounds")
+
+    @classmethod
+    def classic(
+        cls, gray: bool = False, wan: bool = False,
+        max_episodes: int = 4, horizon: int = 96,
+    ) -> "Alphabet":
+        return cls(
+            kinds=KINDS_GRAY if gray else KINDS, wan=wan,
+            max_episodes=max_episodes, horizon=horizon,
+        )
+
+    @property
+    def gray(self) -> bool:
+        return "gray" in self.kinds
+
+    def member(self) -> "Alphabet":
+        """The member-legal subset: gray is compiled out of the
+        membership engine's synchronous network
+        (analysis/mc_member.MEMBER_UNSUPPORTED_KINDS names the
+        rejection), and the membership fleet takes no per-edge
+        matrix knobs."""
+        kinds = tuple(k for k in self.kinds if k != "gray")
+        if not kinds:
+            raise ValueError(
+                "alphabet has no member-legal kinds (gray is the "
+                "only kind and the membership engine rejects it)"
+            )
+        return dataclasses.replace(self, kinds=kinds, wan=False)
+
+    def protocol(self):
+        """WAN alphabets scale the retry ladder to the gene RTT —
+        one protocol config for every lane keeps one envelope (see
+        ``search`` for why LAN timeouts livelock under WAN genes)."""
+        if not self.wan:
+            return None
+        from tpu_paxos.config import ProtocolConfig
+
+        rtt = 2 * GENE_LAT_MAX + 2
+        return ProtocolConfig(
+            prepare_delay_max=rtt,
+            prepare_retry_timeout=rtt,
+            accept_retry_timeout=rtt,
+            commit_retry_timeout=rtt,
+        )
+
+    def sample(self, rng: np.random.Generator, n_nodes: int):
+        """One schedule draw under this alphabet (delegates to
+        :func:`sample_schedule` — same draw sequence)."""
+        return sample_schedule(
+            rng, n_nodes, self.max_episodes, self.horizon,
+            kinds=self.kinds,
+        )
+
+    def sample_episode(
+        self, rng: np.random.Generator, n_nodes: int,
+        crashed=frozenset(), kinds=None,
+    ):
+        """One episode draw under this alphabet (``kinds`` narrows
+        the draw set for cause-targeted mutation; must be a subset)."""
+        use = self.kinds if kinds is None else tuple(kinds)
+        bad = sorted(set(use) - set(self.kinds))
+        if bad:
+            raise ValueError(
+                f"kind(s) outside this alphabet: {', '.join(bad)}"
+            )
+        return sample_episode(
+            rng, n_nodes, self.horizon, crashed=crashed, kinds=use
+        )
 
 
 def sample_episode(
@@ -197,7 +314,145 @@ def sample_edge_knobs(
     )
 
 
-def _generation_margins(rep) -> dict:
+def sample_churn_schedule(
+    rng: np.random.Generator,
+    n_nodes: int,
+    max_events: int = 3,
+    horizon: int = 96,
+    plain_values: int = 2,
+    wait_gates: tuple = (0, 2),
+):
+    """One grammar draw over the MEMBERSHIP-schedule axis (ROADMAP
+    item 3's named follow-on): a bounded sequence of ``ChurnEvent``
+    genes — kind (plain value / add acceptor / del acceptor) x target
+    x quantized ``t0`` (:data:`CHURN_T0_GRID` slots) x wait gate —
+    legal by construction under the churn checker's rules
+    (analysis/mc_member._seq_valid): vids are distinct (a target is
+    added at most once, deleted at most once, and only after its
+    add), node 0 (the harness driver) is never a target, and the
+    first event's gate is ``WAIT_NONE``.  Returns ``None`` for the
+    empty draw — the fault-only lane the checker's variant 0 is.
+
+    ``wait_gates`` defaults to ``(WAIT_NONE, WAIT_APPLIED)`` — the
+    committed churn scope's gate set (analysis/mc_scope.json)."""
+    from tpu_paxos.membership import churn_table as ctm
+    from tpu_paxos.membership import engine as meng
+
+    n_ev = int(rng.integers(0, max_events + 1))
+    if n_ev == 0:
+        return None
+    step = max(1, horizon // CHURN_T0_GRID)
+    events = []
+    plain_used: set = set()
+    added_ever: set = set()
+    live: set = set()
+    for j in range(n_ev):
+        t0 = int(rng.integers(0, CHURN_T0_GRID)) * step
+        wait = (
+            ctm.WAIT_NONE if j == 0
+            else int(wait_gates[int(rng.integers(len(wait_gates)))])
+        )
+        plain_avail = [
+            i for i in range(plain_values) if i not in plain_used
+        ]
+        add_avail = [
+            n for n in range(1, n_nodes) if n not in added_ever
+        ]
+        del_avail = sorted(live)
+        classes = (
+            (["plain"] if plain_avail else [])
+            + (["add"] if add_avail else [])
+            + (["del"] if del_avail else [])
+        )
+        if not classes:
+            break  # alphabet exhausted — shorter schedule, still legal
+        kind = classes[int(rng.integers(len(classes)))]
+        if kind == "plain":
+            i = plain_avail[int(rng.integers(len(plain_avail)))]
+            plain_used.add(i)
+            vid = CHURN_PLAIN_VID_BASE + i
+        elif kind == "add":
+            tgt = add_avail[int(rng.integers(len(add_avail)))]
+            added_ever.add(tgt)
+            live.add(tgt)
+            vid = meng.change_vid(tgt, meng.ADD_ACCEPTOR)
+        else:
+            tgt = del_avail[int(rng.integers(len(del_avail)))]
+            live.discard(tgt)
+            vid = meng.change_vid(tgt, meng.DEL_ACCEPTOR)
+        events.append(ctm.ChurnEvent(vid=vid, t0=t0, wait=wait))
+    if not events:
+        return None
+    return ctm.ChurnSchedule(tuple(events))
+
+
+def churn_targets(churn) -> set:
+    """The acceptor nodes a churn schedule's change events name —
+    the crash-protected set (``{0} | targets``: a scheduled crash
+    inside the epoch acceptor set can wedge its quorum forever,
+    making liveness vacuously unjudgeable; same rule as
+    analysis/mc_member.ChurnEnum.combo_feasible)."""
+    from tpu_paxos.membership import engine as meng
+
+    out: set = set()
+    if churn is None:
+        return out
+    for e in churn.events:
+        if int(e.vid) >= meng.CHANGE_BASE:
+            out.add(meng.decode_change(int(e.vid))[0])
+    return out
+
+
+def sample_member_schedule(
+    rng: np.random.Generator,
+    n_nodes: int,
+    churn=None,
+    max_episodes: int = 2,
+    horizon: int = 96,
+    kinds=None,
+) -> fltm.FaultSchedule:
+    """A fault-schedule draw legal for MEMBERSHIP lanes: member-legal
+    letters only (no gray — the member engine's synchronous network
+    rejects it by name) and scheduled crashes avoid node 0 plus the
+    churn schedule's named targets (passed pre-crashed into the
+    episode sampler, so crash draws land outside the protected set
+    by construction)."""
+    if kinds is None:
+        kinds = tuple(k for k in KINDS if k != "gray")
+    protected = frozenset({0} | churn_targets(churn))
+    n_eps = int(rng.integers(1, max_episodes + 1))
+    eps, crashed = [], set(protected)
+    for _ in range(n_eps):
+        e = sample_episode(rng, n_nodes, horizon, crashed=crashed,
+                           kinds=kinds)
+        if e.kind == "crash":
+            crashed.update(e.nodes)
+        eps.append(e)
+    return fltm.FaultSchedule(tuple(eps))
+
+
+def lane_cause_series(rep, lanes) -> dict:
+    """Per-LANE breach attribution (telemetry/diagnose.label_windows
+    on one lane's own windowed series): ``{lane: cause series}`` for
+    the requested lanes.  The aggregate ``cause_series`` in
+    ``_generation_margins`` blames the generation; this blames the
+    GENOME — evolve's cause-targeted mutation weighting credits the
+    lane that actually produced the label, not whichever lane
+    dominated the aggregate.  Lanes without telemetry are skipped."""
+    from tpu_paxos.telemetry import diagnose as diag
+
+    out: dict = {}
+    for i in lanes:
+        d = rep.lane_telemetry(int(i))
+        if not d or "windows" not in d:
+            continue
+        out[int(i)] = diag.label_windows(
+            d["windows"], region_pairs=d.get("region_pairs")
+        )
+    return out
+
+
+def _generation_margins(rep, flagged=()) -> dict:
     """Reduce one generation's [lanes] flight-recorder summaries to
     the near-miss margin vector: the closest any lane came to a
     liveness wedge (prep for ROADMAP item 2's fitness selection).
@@ -241,6 +496,16 @@ def _generation_margins(rep) -> dict:
         out["cause_series"] = diag.label_windows(
             agg["windows"], region_pairs=agg.get("region_pairs")
         )
+        # per-lane attribution for the FLAGGED lanes: the aggregate
+        # series blames the generation, these blame the genome — a
+        # cause-targeted selection loop must credit the lane that
+        # produced the label (one saturating lane would otherwise
+        # paint every flagged lane's genes "saturation")
+        if flagged:
+            out["lane_causes"] = {
+                str(i): c
+                for i, c in lane_cause_series(rep, sorted(flagged)).items()
+            }
     return out
 
 
@@ -260,43 +525,44 @@ def search(
     verbose: bool = True,
     gray: bool = False,
     wan: bool = False,
+    alphabet: Alphabet | None = None,
 ) -> dict:
     """Run the generation loop; returns the JSON-ready summary.
 
-    ``gray=True`` adds gray-failure episodes to the grammar alphabet
+    The grammar is declared by ``alphabet`` (shared with
+    ``fleet/evolve`` so the samplers cannot drift); when None, the
+    legacy ``gray``/``wan`` booleans build the classic one:
+    ``gray=True`` adds gray-failure episodes to the draw alphabet
     (``KINDS_GRAY``) and ``wan=True`` mutates the per-edge fault
     MATRIX per lane (``sample_edge_knobs``) — both opt-in: they
     change the seeded draw sequences, and the committed fleet-quick
     wedge artifact is pinned against the classic grammar."""
     from tpu_paxos.fleet import envelope as env
     from tpu_paxos.harness import shrink as shr
-    from tpu_paxos.harness import stress as strs
     from tpu_paxos.utils import log as logm
 
+    # the stress workload builder lives outside the replay-critical
+    # DET closure (it drives sweeps, it never makes replayed bytes) —
+    # importlib keeps it out, the same way envelope.py keeps serve out
+    strs = importlib.import_module("tpu_paxos.harness.stress")
     logger = logm.get_logger(
         "fleet", logm.parse_level("INFO" if verbose else "WARN")
     )
+    if alphabet is None:
+        alphabet = Alphabet.classic(
+            gray=gray, wan=wan, max_episodes=max_episodes,
+            horizon=horizon,
+        )
     fault_kw = dict(fault_kw or dict(drop_rate=300, dup_rate=500, max_delay=2))
     wl_rng = np.random.default_rng(base_seed)
     workload, gates, chains = strs._workload(n_prop, wl_rng)
-    if wan:
-        # WAN genes need WAN timeouts: the default retry ladder is
-        # LAN-tuned (2-round timeouts), so a matrix whose edges all
-        # carry multi-round latency livelocks the duel and every lane
-        # reds on liveness — noise, not signal.  Production WAN
-        # deployments scale patience to RTT; so does the search
-        # (one protocol config for all lanes = one envelope).
-        from tpu_paxos.config import ProtocolConfig
-
-        rtt = 2 * GENE_LAT_MAX + 2
-        protocol = ProtocolConfig(
-            prepare_delay_max=rtt,
-            prepare_retry_timeout=rtt,
-            accept_retry_timeout=rtt,
-            commit_retry_timeout=rtt,
-        )
-    else:
-        protocol = None
+    # WAN genes need WAN timeouts: the default retry ladder is
+    # LAN-tuned (2-round timeouts), so a matrix whose edges all
+    # carry multi-round latency livelocks the duel and every lane
+    # reds on liveness — noise, not signal.  Production WAN
+    # deployments scale patience to RTT; so does the search
+    # (one protocol config for all lanes = one envelope).
+    protocol = alphabet.protocol()
     cfg = SimConfig(
         n_nodes=n_nodes,
         n_instances=2 * sum(len(w) for w in workload),
@@ -319,17 +585,16 @@ def search(
 
     runner = env.runner_for(
         cfg, workload, gates, mesh=mesh,
-        max_episodes=max(max_episodes, frun.MAX_EPISODES),
+        max_episodes=max(alphabet.max_episodes, frun.MAX_EPISODES),
         telemetry=True,
     )
     lane_workloads = [(workload, gates)] * n_lanes
     lane_knobs = [cfg.faults] * n_lanes
-    kinds = KINDS_GRAY if gray else KINDS
     extra = (
         {"decision_round_max": int(decision_round_max)}
         if decision_round_max else {}
     )
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
     lanes_total = 0
     wedges: list[dict] = []
     anomalies: list[dict] = []
@@ -337,11 +602,10 @@ def search(
     for g in range(generations):
         sched_rng = np.random.default_rng((base_seed, g))
         schedules = [
-            sample_schedule(sched_rng, n_nodes, max_episodes, horizon,
-                            kinds=kinds)
+            alphabet.sample(sched_rng, n_nodes)
             for _ in range(n_lanes)
         ]
-        if wan:
+        if alphabet.wan:
             # per-lane edge-matrix genes, re-drawn each generation
             # from their own seeded stream (schedule draws untouched)
             knob_rng = np.random.default_rng((base_seed, g, 7))
@@ -378,7 +642,7 @@ def search(
             "generation": g,
             "lanes": n_lanes,
             "flagged": len(flagged),
-            "margins": _generation_margins(rep),
+            "margins": _generation_margins(rep, flagged=flagged),
         })
         for i in sorted(flagged):
             if len(wedges) >= max_wedges:
@@ -432,7 +696,7 @@ def search(
         if len(wedges) >= max_wedges:
             logger.info("wedge budget (%d) reached", max_wedges)
             break
-    seconds = time.perf_counter() - t0
+    seconds = time.perf_counter() - t0  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
     real = [w for w in wedges if not w["synthetic"]]
     return {
         "metric": "fleet_search",
@@ -496,8 +760,11 @@ def main(argv=None) -> int:
     # request coerces auto -> cpu so virtual devices actually get
     # provisioned, and a short mesh fails loudly — silently running
     # unmeshed would let the user believe the tile was exercised
-    from tpu_paxos.__main__ import _select_backend
-
+    # (importlib: the CLI module is not replay-critical and must not
+    # join this module's DET closure)
+    _select_backend = importlib.import_module(
+        "tpu_paxos.__main__"
+    )._select_backend
     mesh = None
     if args.mesh:
         backend = "cpu" if args.backend == "auto" else args.backend

@@ -325,7 +325,7 @@ class _Budget:
 
 def shrink_case(
     case: ReproCase, max_evals: int = MAX_EVALS, logger=None,
-    batch: bool = True,
+    batch: bool = True, stats: dict | None = None,
 ) -> tuple[ReproCase, str]:
     """Greedily minimize a failing case (see module doc for the move
     set).  Returns (shrunk case, its violation).  Raises ValueError if
@@ -542,6 +542,12 @@ def shrink_case(
                     break
             else:
                 break
+    if stats is not None:
+        # Candidate-eval count for the caller's recall accounting
+        # (evolve's lanes-to-shrunk-artifact); an out-param so the
+        # (case, violation) return shape every caller unpacks stays
+        # put.  left can undershoot 0 by at most the final batch.
+        stats["evals"] = max_evals - max(budget.left, 0)
     return case, viol
 
 
@@ -705,15 +711,23 @@ def triage(
 ) -> dict:
     """The sweep's failure hook: shrink the failing case and write its
     repro artifact.  Returns the artifact dict plus a
-    ``shrink_seconds`` wall-time key (reported in the sweep/search
+    ``shrink_seconds`` wall-time key and a ``shrink_evals``
+    candidate-eval count (reported in the sweep/search/evolve
     summaries; NOT written to the artifact file, whose schema is
     closed)."""
     import time
 
     t0 = time.perf_counter()  # paxlint: allow[DET001] triage wall-time metric, never serialized into the artifact
-    small, viol = shrink_case(case, max_evals=max_evals, logger=logger)
+    stats: dict = {}
+    small, viol = shrink_case(
+        case, max_evals=max_evals, logger=logger, stats=stats
+    )
     art = save_artifact(out_path, small, viol)
     seconds = time.perf_counter() - t0  # paxlint: allow[DET001] triage wall-time metric, never serialized into the artifact
     if logger is not None:
         logger.info("shrink: wall time %.2fs", seconds)
-    return dict(art, shrink_seconds=round(seconds, 2))
+    return dict(
+        art,
+        shrink_seconds=round(seconds, 2),
+        shrink_evals=int(stats.get("evals", 0)),
+    )

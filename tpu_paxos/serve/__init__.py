@@ -19,7 +19,7 @@ over ``[lanes]`` tenant streams with on-device per-lane SLO
 verdicts — its own audit provider).
 """
 
-_SUBMODULES = ("arrivals", "driver", "fleet", "harness")
+_SUBMODULES = ("arrivals", "breach", "driver", "fleet", "harness")
 
 
 def __getattr__(name):

@@ -824,6 +824,55 @@ def stall_margin_series(w: WindowSummary, patience: int) -> list:
     return (int(patience) - stall).astype(np.int64).tolist()
 
 
+def lane_stall_margins(w: WindowSummary, patience: int) -> list:
+    """Per-LANE fitness vector for the selection loop (evolve): for
+    each lane of a ``[lanes, W]`` window stack, the minimum over
+    buckets of ``patience - stall_max`` — the tightest liveness
+    headroom that genome reached anywhere in its run.  Lower is
+    fitter for wedge hunting; <= 0 means the lane actually tripped
+    the stall threshold.  Unlike :func:`stall_margin_series` (which
+    reduces ACROSS lanes first and so cannot credit a margin to the
+    genome that produced it), this keeps the lane axis so selection
+    can rank individuals.  A single ``[W]`` lane yields a length-1
+    vector."""
+    stall = np.asarray(w.stall_max)
+    if stall.ndim == 1:
+        stall = stall[None, :]
+    return (int(patience) - stall.max(axis=1)).astype(np.int64).tolist()
+
+
+def lane_burn_rates(
+    lat_hist, latency_rounds: int, budget_milli: int
+) -> list:
+    """Per-LANE windowed SLO burn fitness for the serve axis of the
+    selection loop: for each lane of a ``[lanes, W, B]`` windowed
+    latency-histogram stack, the MAXIMUM over windows of the burn
+    rate at ``latency_rounds`` — same bucket-edge and budget
+    semantics as the serve judge (``harness._judge_series``): bad =
+    decided past the bucket edge covering ``latency_rounds``, burn =
+    bad/decided/budget, empty windows burn 0.  Higher is fitter for
+    breach hunting; >= the SLO's ``burn_breach`` means that genome's
+    lane breached.  A single ``[W, B]`` lane yields a length-1
+    vector."""
+    import bisect
+
+    hist = np.asarray(lat_hist, np.int64)
+    if hist.ndim == 2:
+        hist = hist[None, :, :]
+    k = bisect.bisect_right(LAT_EDGES, int(latency_rounds))
+    tot = hist.sum(axis=2)
+    bad = hist[:, :, k:].sum(axis=2)
+    budget = max(int(budget_milli), 1) / 1000.0
+    out = []
+    for li in range(hist.shape[0]):
+        burns = [
+            round(float(b) / float(t) / budget, 3) if t else 0.0
+            for b, t in zip(bad[li], tot[li])
+        ]
+        out.append(max(burns) if burns else 0.0)
+    return out
+
+
 def reduce_lanes(
     s: TelemetrySummary,
     windows: WindowSummary | None = None,
