@@ -8,7 +8,8 @@ PY ?= python
 	shard-audit bench bench-sharded parity parity-fast replay-diff \
 	replay-diff-member run stress stress-quick fleet fleet-quick \
 	evolve evolve-quick mc mc-quick serve serve-quick serve-fleet \
-	serve-fleet-quick serve-control serve-control-quick clean
+	serve-fleet-quick serve-control serve-control-quick \
+	envelope-quick clean
 
 # Fast tier: every feature covered, heavy literal-size / long-schedule
 # variants deselected (marked slow).  ~6 min; test-slow runs everything.
@@ -69,7 +70,7 @@ shard-audit:
 # un-jitted op-by-op smoke of one tiny config per engine (every cond
 # predicate, slice bound, and dtype materializes eagerly).  The pallas
 # interpreter path is part of the fast tier (tests/test_fastwin.py).
-check: lint audit shard-audit mc-quick evolve-quick serve-quick serve-fleet-quick serve-control-quick
+check: lint audit shard-audit mc-quick evolve-quick envelope-quick serve-quick serve-fleet-quick serve-control-quick
 	JAX_DEBUG_NANS=1 $(PY) -m pytest tests/ -x -q -m "not slow"
 	JAX_DISABLE_JIT=1 JAX_DEBUG_NANS=1 $(PY) scripts/check_smoke.py
 
@@ -172,6 +173,17 @@ mc:
 # compiles).
 mc-quick:
 	$(PY) -m tpu_paxos mc --scope quick,gray,churn,control --triage-dir stress-triage
+
+# Geometry-padded envelope smoke (wired into make check): ONE padded
+# fleet executable must serve the whole (geometry x protocol-knob x
+# rate) grid — the fast-tier collapse cell dispatches an 8-cell grid
+# through one cached runner and pins a ZERO warm-compile census after
+# the first dispatch, plus cache-identity across geometries.
+# Decision-log parity and the named-rejection surface ride the same
+# module's other fast cells (and the tier-1 run).
+envelope-quick:
+	JAX_PLATFORMS=cpu $(PY) -m pytest \
+	  tests/test_envelope_pad.py::test_envelope_compile_collapse -x -q
 
 # Open-loop serving (tpu_paxos/serve/): Poisson arrivals at an
 # offered rate (values per 1000 rounds) admitted mid-flight through

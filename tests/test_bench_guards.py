@@ -170,6 +170,82 @@ def test_geo_record_withholds_implausible_rate():
     assert "value" not in rec
 
 
+def test_envelope_record_publishes_padding_toll():
+    # two geometries, 8 lanes of ~1 MiB state over >= 30 rounds
+    rec = bench._envelope_record(
+        {"3-node": {"unpadded": [0.10, 0.11, 0.12],
+                    "padded": [0.20, 0.22, 0.24]},
+         "5-node": {"unpadded": [0.20, 0.21, 0.22],
+                    "padded": [0.30, 0.33, 0.36]}},
+        {"3-node": {"unpadded": 4 << 20, "padded": 8 << 20},
+         "5-node": {"unpadded": 6 << 20, "padded": 8 << 20}},
+        30, 8, 1, 0, 6, [], [], {"devices": 1},
+    )
+    v = rec["value"]["3-node"]
+    assert v["unpadded_lanes_per_sec"] == pytest.approx(8 / 0.11, abs=0.005)
+    assert v["padded_lanes_per_sec"] == pytest.approx(8 / 0.22, abs=0.005)
+    assert v["padding_toll_pct"] == pytest.approx(100.0, abs=0.5)
+    assert rec["executables_before"] == 6
+    assert rec["executables_after"] == 1
+    assert rec["warm_compiles_in_sweep"] == 0
+
+
+def test_envelope_record_withholds_on_warm_compiles():
+    """The record's claim IS the one shared padded executable: any
+    compile after the first dispatch of the grid withholds the whole
+    record, plausible timings or not."""
+    rec = bench._envelope_record(
+        {"3-node": {"unpadded": [0.10, 0.11, 0.12],
+                    "padded": [0.20, 0.22, 0.24]}},
+        {"3-node": {"unpadded": 4 << 20, "padded": 8 << 20}},
+        30, 8, 1, 3, 6, [], [], {"devices": 1},
+    )
+    assert "error" in rec and "one-padded-executable" in rec["error"]
+    assert "value" not in rec
+
+
+def test_envelope_record_withholds_on_parity_failure():
+    """A padded-vs-bound-free decision-log mismatch means padding
+    forked the model — withheld naming the lane."""
+    rec = bench._envelope_record(
+        {"3-node": {"unpadded": [0.10], "padded": [0.20]}},
+        {"3-node": {"unpadded": 4 << 20, "padded": 8 << 20}},
+        30, 8, 1, 0, 6,
+        ["3-node lane 2: padded dispatch != bound-free twin"],
+        [], {"devices": 1},
+    )
+    assert "error" in rec and "parity withheld" in rec["error"]
+    assert "lane 2" in rec["error"]
+    assert "value" not in rec
+
+
+def test_envelope_record_withholds_unconverged_lanes():
+    """lanes/sec TO VERDICT: a lane that rides out max_rounds makes
+    the timing a measurement of the cap — withheld by name."""
+    rec = bench._envelope_record(
+        {"7-node": {"unpadded": [0.10], "padded": [0.20]}},
+        {"7-node": {"unpadded": 4 << 20, "padded": 8 << 20}},
+        30, 8, 1, 0, 6, [],
+        ["7-node/padded rep 0: 8 lane(s) without a verdict"],
+        {"devices": 1},
+    )
+    assert "error" in rec and "to-verdict withheld" in rec["error"]
+    assert "7-node" in rec["error"]
+    assert "value" not in rec
+
+
+def test_envelope_record_withholds_implausible_rate():
+    rec = bench._envelope_record(
+        {"5-node": {"unpadded": [1e-6, 2e-6, 3e-6],
+                    "padded": [0.20, 0.22, 0.24]}},
+        {"5-node": {"unpadded": 1 << 30, "padded": 1 << 30}},
+        1000, 64, 1, 0, 6, [], [], {"devices": 1},
+    )
+    assert "error" in rec and "roofline" in rec["error"]
+    assert "5-node/unpadded" in rec["error"]
+    assert "value" not in rec
+
+
 def test_serve_record_publishes_plausible_rate():
     # ~1 MiB of loop state over >= 100 rounds in ~0.5 s: fine
     pts = [{"rate_milli": 4000, "p99": 30, "sustained": True}]

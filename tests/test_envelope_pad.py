@@ -1,0 +1,564 @@
+"""Geometry-padded envelopes (core/geom.py): ONE compiled executable
+serves every tenant geometry on the menu.
+
+The contract under test, stacked on the runtime-knob parity of
+tests/test_knobs.py: an engine built with a ``GeometryEnvelope`` pads
+its node/proposer axes to the menu bound, takes the TRUE geometry and
+the protocol constants as runtime data, and is decision-log
+sha256-IDENTICAL to the bound-free engine per (cfg, schedule, seed) —
+the menu-switched PRNG draws (``geo.menu_randint``; threefry bits are
+shape-dependent) are the bit-exactness anchor.  Absent nodes are
+permanently masked (never sampled, never quorum-counted), and the
+envelope cache collapses geometry + protocol out of its key, so a
+(geometry x protocol-knob x rate) grid costs dispatches, not compiles
+— pinned live by the compile census.
+"""
+
+import dataclasses
+import hashlib
+
+import numpy as np
+import pytest
+
+from tpu_paxos.analysis import tracecount
+from tpu_paxos.config import (
+    EdgeFaultConfig, FaultConfig, ProtocolConfig, SimConfig,
+)
+from tpu_paxos.core import faults as flt
+from tpu_paxos.core import geom as geo
+from tpu_paxos.core import net as netm
+from tpu_paxos.fleet import envelope as env
+from tpu_paxos.fleet import runner as frun
+from tpu_paxos.replay.decision_log import decision_log
+
+#: The fast-tier envelope: 3-node single-proposer tenants padded into
+#: a 5-node two-proposer bound.
+ENV35 = geo.GeometryEnvelope(menu=((3, (0,)), (5, (0, 1))))
+#: The slow-tier envelope: the full 3/5/7 menu of the BENCH sweep.
+ENV357 = geo.GeometryEnvelope(
+    menu=((3, (0,)), (5, (0, 1)), (7, (0, 1, 2)))
+)
+
+#: Workload template (defines the envelope's vid bound and queue
+#: capacity) and the true-geometry lane workloads cut from it —
+#: per-lane rows must match the template's row length (the envelope's
+#: queue-capacity contract), so the 3-node single-proposer lane names
+#: ONE row of the same width.
+TMPL = [np.arange(100, 108, dtype=np.int32),
+        np.arange(200, 208, dtype=np.int32)]
+WL3 = [np.arange(100, 108, dtype=np.int32)]
+WL5 = TMPL
+
+#: 3-node-safe episode mix (no node past id 2).
+SCHED3 = flt.FaultSchedule((
+    flt.pause(1, 4, 1),
+    flt.burst(5, 10, 1500),
+))
+#: 5-node mixes: the knob-parity grid's schedule, and a gray/WAN-
+#: weather mix with a deterministic crash point.
+SCHED5 = flt.FaultSchedule((
+    flt.partition(4, 16, (0, 1), (2, 3, 4)),
+    flt.pause(6, 14, 2),
+    flt.burst(5, 12, 1500),
+))
+GRAY5 = flt.FaultSchedule((
+    flt.partition(2, 8, (0, 1), (2, 3, 4)),
+    flt.gray(3, 9, 2, delay=2),
+    flt.crash(20, 4),
+))
+
+
+def _cfg(n_nodes, proposers, fkw, seed=3, max_rounds=4000, pc=None):
+    return SimConfig(
+        n_nodes=n_nodes, n_instances=16, proposers=proposers, seed=seed,
+        max_rounds=max_rounds, faults=FaultConfig(**fkw),
+        protocol=pc or ProtocolConfig(),
+    )
+
+
+def _log_sha(r):
+    stride = int(max(int(np.max(w)) for w in TMPL)) + 1
+    text = decision_log(
+        r.chosen_vid, r.chosen_ballot, stride=stride,
+        n_instances=len(r.chosen_vid),
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _assert_pad_parity(rep_true, rep_pad, n_true):
+    """Lane-for-lane: the padded dispatch is decision-log
+    sha256-identical AND bit-identical to the bound-free dispatch of
+    the same (cfg, schedule, seed); pad nodes never crash and never
+    learn."""
+    assert rep_true.n_lanes == rep_pad.n_lanes
+    for i in range(rep_true.n_lanes):
+        a = rep_true.lane_result(i)
+        b = rep_pad.lane_result(i)
+        assert a.rounds == b.rounds, (i, a.rounds, b.rounds)
+        assert _log_sha(a) == _log_sha(b), i
+        assert (a.chosen_vid == b.chosen_vid).all(), i
+        assert (a.chosen_round == b.chosen_round).all(), i
+        # paxlint: allow[JAX103] per-lane bit-compare IS this assert's purpose
+        assert (np.asarray(a.learned)
+                == np.asarray(b.learned)[:, :n_true]).all(), i  # paxlint: allow[JAX103] per-lane bit-compare IS this assert's purpose
+        # paxlint: allow[JAX103] per-lane bit-compare IS this assert's purpose
+        assert (np.asarray(a.crashed)
+                == np.asarray(b.crashed)[:n_true]).all(), i  # paxlint: allow[JAX103] per-lane bit-compare IS this assert's purpose
+        assert not np.asarray(b.crashed)[n_true:].any(), (  # paxlint: allow[JAX103] per-lane bit-compare IS this assert's purpose
+            f"lane {i}: a permanently-masked pad node crashed"
+        )
+        assert a.done == b.done, i
+        va, vb = rep_true.verdict, rep_pad.verdict
+        for f in ("ok", "agreement", "coverage", "quiescent"):
+            assert bool(getattr(va, f)[i]) == bool(getattr(vb, f)[i]), (
+                i, f,
+            )
+
+
+# ---------------- decision-log parity ----------------
+
+# The two fleet-padded cells below pay the padded executable's cold
+# compile (~70 s on the 2-core CPU box) and are slow-marked per the
+# tier-1 budget rule.  Fast-tier coverage of this module:
+# test_member_pad_parity_3in5 + test_envelope_named_rejections here,
+# the envelope guard cells in tests/test_bench_guards.py, and
+# `make envelope-quick` (wired into `make check`) which runs
+# test_envelope_compile_collapse by node id regardless of marks.
+
+
+@pytest.mark.slow
+def test_pad_parity_3in5():
+    """Fast parity cell: a 3-node single-proposer tenant dispatched
+    through the 5-node-bound padded executable vs the bound-free
+    3-node build — debug.conf knobs, pause+burst schedule, two seeds.
+    The padded runner comes from the ENVELOPE CACHE (the surface every
+    consumer actually calls)."""
+    fkw = dict(drop_rate=500, dup_rate=1000, max_delay=2)
+    cfg3 = _cfg(3, (0,), fkw)
+    kn = [cfg3.faults] * 2
+    r3 = frun.FleetRunner(cfg3, WL3)
+    rep3 = r3.run([3, 5], [SCHED3] * 2,
+                  workloads=[(WL3, None)] * 2, knobs=kn)
+    # bound-free runners reject padded dispatch inputs by name
+    with pytest.raises(ValueError, match="geometry-padded dispatch"):
+        r3.run([3], [None], workloads=[(WL3, None)], knobs=kn[:1],
+               geometry=(3, (0,)))
+    rp = env.runner_for(cfg3, TMPL, geometry=ENV35)
+    repp = rp.run([3, 5], [SCHED3] * 2,
+                  workloads=[(WL3, None)] * 2, knobs=kn,
+                  geometry=(3, (0,)), protocol=cfg3.protocol)
+    _assert_pad_parity(rep3, repp, 3)
+    # the report replays as the TRUE geometry, not the bound
+    assert repp.lane_cfg(0).n_nodes == 3
+    assert repp.lane_cfg(0).proposers == (0,)
+
+
+@pytest.mark.slow
+def test_envelope_compile_collapse():
+    """The tentpole pin: ONE warm executable serves the whole
+    (geometry x protocol-knob x rate) grid — the live compile census
+    reads ZERO fleet compiles after the first dispatch.  Also pins the
+    cache collapse itself: every true geometry and knob mix of the
+    envelope lands on the SAME cached runner object."""
+    cfg3 = _cfg(3, (0,), dict(max_delay=2))
+    cfg5 = _cfg(5, (0, 1), dict(drop_rate=500, max_delay=4))
+    rp = env.runner_for(cfg3, TMPL, geometry=ENV35)
+    assert env.runner_for(cfg5, TMPL, geometry=ENV35) is rp
+    pc2 = ProtocolConfig(
+        prepare_retry_timeout=5, accept_retry_timeout=3,
+        commit_retry_timeout=4,
+    )
+    grid = [
+        (gmx, wl, sc, pc, dr)
+        for gmx, wl, sc in (
+            ((3, (0,)), WL3, SCHED3), ((5, (0, 1)), WL5, SCHED5),
+        )
+        for pc in (ProtocolConfig(), pc2)
+        for dr in (0, 900)
+    ]
+    census = tracecount.CompileCensus().start()
+    first = grid[0]
+    gmx, wl, sc, pc, dr = first
+    kn = [FaultConfig(max_delay=4, drop_rate=dr, crash_rate=800)] * 2
+    rp.run([3, 5], [sc] * 2, workloads=[(wl, None)] * 2, knobs=kn,
+           geometry=gmx, protocol=pc)
+    warm = census.engine_counts.get("fleet", 0)
+    for gmx, wl, sc, pc, dr in grid[1:]:
+        kn = [FaultConfig(max_delay=4, drop_rate=dr, crash_rate=800)] * 2
+        rp.run([3, 5], [sc] * 2, workloads=[(wl, None)] * 2, knobs=kn,
+               geometry=gmx, protocol=pc)
+    census.stop()
+    assert census.engine_counts.get("fleet", 0) == warm, (
+        "a warm grid cell recompiled the fleet executable — the "
+        "geometry-padded envelope should serve every cell"
+    )
+
+
+# ---------------- named rejections ----------------
+
+
+def test_envelope_named_rejections():
+    """Every envelope boundary rejects BY NAME: over-bound and
+    off-menu geometries, out-of-span protocol knobs, over-bound knob
+    matrices and workloads, and runners built off the bound.
+    Construction is lazy (jit compiles on first dispatch), so these
+    cells cost no executables."""
+    with pytest.raises(ValueError, match="exceeds the envelope geometry"):
+        ENV35.index_of(9, (0,))
+    with pytest.raises(ValueError, match="not in the envelope menu"):
+        ENV35.index_of(4, (0,))
+    with pytest.raises(ValueError, match="exceeds the envelope geometry"):
+        ENV35.index_of_nodes(9)
+    with pytest.raises(ValueError, match="outside its declared span"):
+        geo.protocol_knobs(ProtocolConfig(), stall_patience=0)
+    with pytest.raises(ValueError, match="knob matrix"):
+        netm.pad_matrix_knobs(
+            netm.matrix_knobs(FaultConfig(max_delay=2), 7), 5
+        )
+    with pytest.raises(ValueError, match="workload names"):
+        frun._pad_geometry_workload([np.arange(3)] * 3, None, 2)
+    cfg3 = _cfg(3, (0,), dict(max_delay=2))
+    with pytest.raises(ValueError, match="built at the envelope bound"):
+        frun.FleetRunner(cfg3, WL3, geometry=ENV35)
+    # the cached padded runner rejects a bound-free dispatch shape
+    rp = env.runner_for(cfg3, TMPL, geometry=ENV35)
+    with pytest.raises(ValueError, match="TRUE geometry per dispatch"):
+        rp.run([3], [None], workloads=[(WL3, None)],
+               knobs=[FaultConfig()])
+    # a directly-built padded runner (no cache guard in front) still
+    # demands the true-geometry owner map
+    rp_direct = frun.FleetRunner(
+        ENV35.bound_cfg(cfg3), TMPL, geometry=ENV35
+    )
+    with pytest.raises(ValueError, match="needs explicit workloads="):
+        rp_direct.run([3], [None], knobs=[FaultConfig()],
+                      geometry=(3, (0,)))
+    # off-menu dispatches and out-of-span knob mixes, per dispatch
+    with pytest.raises(ValueError, match="not in the envelope menu"):
+        rp.run([3], [None], workloads=[(WL3, None)],
+               knobs=[FaultConfig()], geometry=(4, (0,)))
+    with pytest.raises(ValueError, match="outside its declared span"):
+        rp.run([3], [None], workloads=[(WL3, None)],
+               knobs=[FaultConfig()], geometry=(3, (0,)),
+               protocol=ProtocolConfig(prepare_retry_timeout=10_000))
+    # member stack: same named boundaries
+    from tpu_paxos.fleet import member_runner as mfr
+
+    with pytest.raises(ValueError, match="exceeds the envelope geometry"):
+        env.member_runner_for(9, 8, geometry=ENV35)
+    with pytest.raises(ValueError, match="envelope node bound"):
+        mfr.MemberFleetRunner(3, 8, geometry=ENV35)
+    rm = env.member_runner_for(3, 8, max_events=4, geometry=ENV35)
+    with pytest.raises(ValueError, match="TRUE node count"):
+        rm.run([0], [None], [None])
+    rm0 = mfr.MemberFleetRunner(3, 8, max_events=4)
+    with pytest.raises(ValueError, match="geometry-padded dispatch"):
+        rm0.run([0], [None], [None], n_nodes=3)
+
+
+# ---------------- membership stack: fast cell ----------------
+
+
+def test_member_pad_parity_3in5():
+    """Membership twin of the fast parity cell: a 3-node churn fleet
+    dispatched through the 5-node-bound padded member executable is
+    decision-LOG byte-identical to the bound-free build (the member
+    engine's only geometry-shaped draws are its backoff and crash
+    coins — both menu-switched)."""
+    from tpu_paxos.fleet import member_runner as mfr
+    from tpu_paxos.membership import churn_table as ctm
+    from tpu_paxos.membership import engine as meng
+
+    churns = [
+        ctm.ChurnSchedule((
+            ctm.ChurnEvent(vid=100),
+            ctm.ChurnEvent(
+                vid=meng.change_vid(1, meng.ADD_ACCEPTOR),
+                wait=ctm.WAIT_CHOSEN,
+            ),
+        )),
+        ctm.ChurnSchedule((
+            ctm.ChurnEvent(vid=200),
+            ctm.ChurnEvent(vid=201, wait=ctm.WAIT_CHOSEN),
+        )),
+    ]
+    scheds = [flt.FaultSchedule((flt.pause(2, 5, 1),)), None]
+    r3 = mfr.MemberFleetRunner(
+        3, 8, max_events=4, max_episodes=2, crash_rate=500, max_rounds=64
+    )
+    rp = env.member_runner_for(
+        3, 8, max_events=4, max_episodes=2, crash_rate=500,
+        max_rounds=64, geometry=ENV35,
+    )
+    # cache collapse: both menu geometries land on the same runner
+    assert env.member_runner_for(
+        5, 8, max_events=4, max_episodes=2, crash_rate=500,
+        max_rounds=64, geometry=ENV35,
+    ) is rp
+    rep3 = r3.run([0, 1], churns, scheds)
+    census = tracecount.CompileCensus().start()
+    repp = rp.run([0, 1], churns, scheds, n_nodes=3)
+    warm = census.engine_counts.get("member", 0)
+    repp2 = rp.run([1, 0], churns, scheds, n_nodes=3)
+    census.stop()
+    assert census.engine_counts.get("member", 0) == warm, (
+        "a warm member dispatch recompiled the padded executable"
+    )
+    assert repp2.n_lanes == 2
+    for i in range(2):
+        assert rep3.lane_log(i) == repp.lane_log(i), i
+        for f in ("ok", "quorum", "catchup", "coverage", "completed"):
+            assert (bool(getattr(rep3.verdict, f)[i])
+                    == bool(getattr(repp.verdict, f)[i])), (i, f)
+
+
+# ---------------- decision-log parity: slow grid ----------------
+
+
+@pytest.mark.slow
+def test_pad_parity_5in7_grid():
+    """Heavy parity grid, 7-node bound: 5-in-7 and 3-in-7 builds
+    across episode mixes (partition+pause+burst, partition+gray+crash,
+    schedule-free) x knob tiers (zero, debug.conf) plus a WAN
+    edge-matrix cell — every cell decision-log sha256-identical to the
+    bound-free build, all through ONE padded executable.
+
+    Slow tier: two bound-free compiles + one 7-bound padded compile
+    (~2-3 min).  Fast-tier coverage: test_pad_parity_3in5 pins the
+    same parity contract at the 5-node bound, and
+    test_envelope_compile_collapse pins the census on the same grid
+    shape every tier-1 run."""
+    rp = env.runner_for(
+        _cfg(7, (0, 1, 2), dict(max_delay=4)), TMPL, geometry=ENV357
+    )
+    wan = FaultConfig(
+        max_delay=4,
+        edges=EdgeFaultConfig(
+            drop_rate=np.full((5, 5), 300, np.int32),
+            dup_rate=np.full((5, 5), 200, np.int32),
+            min_delay=np.zeros((5, 5), np.int32),
+            max_delay=np.full((5, 5), 3, np.int32),
+        ),
+    )
+    cells5 = [
+        (SCHED5, FaultConfig()),
+        (SCHED5, FaultConfig(drop_rate=500, dup_rate=1000, max_delay=2,
+                             crash_rate=3000)),
+        (GRAY5, FaultConfig(drop_rate=300, max_delay=4, crash_rate=800)),
+        (None, wan),
+    ]
+    cfg5 = _cfg(5, (0, 1), dict(max_delay=4))
+    r5 = frun.FleetRunner(cfg5, WL5)
+    census = tracecount.CompileCensus().start()
+    for sched, fc in cells5:
+        rep5 = r5.run([3, 5], [sched] * 2,
+                      workloads=[(WL5, None)] * 2, knobs=[fc] * 2)
+        repp = rp.run([3, 5], [sched] * 2,
+                      workloads=[(WL5, None)] * 2, knobs=[fc] * 2,
+                      geometry=(5, (0, 1)))
+        _assert_pad_parity(rep5, repp, 5)
+    # 3-in-7: the same executable, two menu steps below the bound
+    cfg3 = _cfg(3, (0,), dict(max_delay=4))
+    r3 = frun.FleetRunner(cfg3, WL3)
+    rep3 = r3.run([3, 5], [SCHED3] * 2,
+                  workloads=[(WL3, None)] * 2,
+                  knobs=[FaultConfig(drop_rate=500, max_delay=2)] * 2)
+    before = census.engine_counts.get("fleet", 0)
+    repp3 = rp.run([3, 5], [SCHED3] * 2,
+                   workloads=[(WL3, None)] * 2,
+                   knobs=[FaultConfig(drop_rate=500, max_delay=2)] * 2,
+                   geometry=(3, (0,)))
+    census.stop()
+    _assert_pad_parity(rep3, repp3, 3)
+    assert census.engine_counts.get("fleet", 0) - before <= 2, (
+        "switching true geometry under the padded envelope recompiled"
+    )
+
+
+@pytest.mark.slow
+def test_member_pad_parity_5in7():
+    """Membership slow cell: a 5-node churn fleet (growth churn +
+    pause and crash weather) through the 7-node-bound padded member
+    executable, log-identical to the bound-free build.  Fast-tier
+    coverage: test_member_pad_parity_3in5 pins the same contract at
+    the 5-node bound every tier-1 run."""
+    from tpu_paxos.fleet import member_runner as mfr
+    from tpu_paxos.membership import churn_table as ctm
+    from tpu_paxos.membership import engine as meng
+
+    churns = [
+        ctm.ChurnSchedule((
+            ctm.ChurnEvent(vid=100),
+            ctm.ChurnEvent(
+                vid=meng.change_vid(3, meng.ADD_ACCEPTOR),
+                wait=ctm.WAIT_CHOSEN,
+            ),
+            ctm.ChurnEvent(
+                vid=meng.change_vid(4, meng.ADD_ACCEPTOR),
+                wait=ctm.WAIT_APPLIED,
+            ),
+        )),
+        ctm.ChurnSchedule((
+            ctm.ChurnEvent(vid=200),
+            ctm.ChurnEvent(vid=201, wait=ctm.WAIT_CHOSEN),
+        )),
+    ]
+    scheds = [
+        flt.FaultSchedule((flt.pause(2, 5, 1),)),
+        flt.FaultSchedule((flt.crash(8, 2),)),
+    ]
+    r5 = mfr.MemberFleetRunner(
+        5, 8, max_events=4, max_episodes=2, crash_rate=500,
+        max_rounds=96,
+    )
+    rp = env.member_runner_for(
+        5, 8, max_events=4, max_episodes=2, crash_rate=500,
+        max_rounds=96, geometry=ENV357,
+    )
+    rep5 = r5.run([0, 1], churns, scheds)
+    repp = rp.run([0, 1], churns, scheds, n_nodes=5)
+    for i in range(2):
+        assert rep5.lane_log(i) == repp.lane_log(i), i
+        for f in ("ok", "quorum", "catchup", "coverage", "completed"):
+            assert (bool(getattr(rep5.verdict, f)[i])
+                    == bool(getattr(repp.verdict, f)[i])), (i, f)
+
+
+# ---------------- serve stack ----------------
+
+
+@pytest.mark.slow
+def test_serve_pad_parity():
+    """Serve-window parity: 3- and 5-node tenants admitted through ONE
+    padded donated window executable produce the exact chosen
+    (vid, ballot) streams of their bound-free windows, across chained
+    dispatches.  Slow tier: three window compiles (~2 min).  Fast-tier
+    coverage: the padded round function is the SAME one
+    test_pad_parity_3in5 pins (serve windows wrap it), and ``make
+    audit`` traces the padded serve window (serve.window_envelope)
+    with an HLO golden."""
+    import jax.numpy as jnp
+
+    from tpu_paxos.core import sim as simm
+    from tpu_paxos.core import values as val
+    from tpu_paxos.serve import driver as sdrv
+    from tpu_paxos.utils import prng
+
+    def tcfg(n, props):
+        return _cfg(n, props, dict(max_delay=2, drop_rate=300), seed=3)
+
+    def run(cfg, wl, geometry=None, gmx=None):
+        v = sdrv.vid_bound_of(wl)
+        root = prng.root_key(cfg.seed)
+        gm = pkn = None
+        bcfg = cfg
+        if geometry is not None:
+            bcfg = geometry.bound_cfg(cfg)
+            gm = geo.geometry_for(geometry, *gmx)
+            pkn = geo.protocol_knobs(
+                cfg.protocol, stall_patience=simm.IDLE_RESTART_ROUNDS
+            )
+            wl, _ = frun._pad_geometry_workload(
+                wl, None, geometry.bound_proposers
+            )
+        ss, c = sdrv.init_serve_state(
+            bcfg, wl, v, root, window_rounds=8,
+            geometry=geometry, geom=gm, pknobs=pkn,
+        )
+        fn = sdrv.window_for(
+            bcfg, c, v, 8, window_rounds=8, geometry=geometry
+        )
+        p = len(bcfg.proposers)
+        K, S = 4, 2
+        admits = np.full((S, p, K), int(val.NONE), np.int32)
+        arrs = np.zeros((S, p, K), np.int32)
+        for pi, w in enumerate(wl):
+            w = np.asarray(w, np.int32)
+            for si in range(S):
+                blk = w[si * K:(si + 1) * K]
+                admits[si, pi, :len(blk)] = blk
+                arrs[si, pi, :len(blk)] = si * 8
+        args = (ss, root, jnp.asarray(admits), jnp.asarray(arrs))
+        if geometry is not None:
+            args = args + (gm, pkn)
+        for _ in range(4):
+            out = fn(*args)
+            ss = out[0]
+            args = (ss,) + args[1:]
+        return (np.asarray(ss.sim.met.chosen_vid),
+                np.asarray(ss.sim.met.chosen_ballot))
+
+    cv3, cb3 = run(tcfg(3, (0,)), WL3)
+    cv5u, cb5u = run(tcfg(5, (0, 1)), WL5)
+    census = tracecount.CompileCensus().start()
+    cv3p, cb3p = run(tcfg(3, (0,)), WL3, geometry=ENV35, gmx=(3, (0,)))
+    warm = census.engine_counts.get("serve", 0)
+    cv5p, cb5p = run(tcfg(5, (0, 1)), WL5, geometry=ENV35,
+                     gmx=(5, (0, 1)))
+    census.stop()
+    assert (cv3 == cv3p).all() and (cb3 == cb3p).all()
+    assert (cv5u == cv5p).all() and (cb5u == cb5p).all()
+    assert census.engine_counts.get("serve", 0) == warm, (
+        "the second tenant geometry recompiled the serve window"
+    )
+
+
+# ---------------- model checker rides the padded envelope ----------------
+
+
+@pytest.mark.slow
+def test_mc_quick_chunk_padded_byte_equality():
+    """The mc quick scope's verdict nibbles are BYTE-IDENTICAL when
+    its lanes dispatch through a geometry-padded telemetry runner at
+    the 7-node bound — the certified scope is the degenerate case of
+    the envelope, not a fork.  One chunk (16 lanes) bounds the cost;
+    the full certificate stays pinned by ``make mc-quick`` on the
+    bound-free path.  Fast-tier coverage: test_pad_parity_3in5 pins
+    the underlying engine parity; the telemetry lane shape is traced
+    by ``make audit`` (fleet.run_lanes_telemetry)."""
+    from tpu_paxos.analysis import modelcheck as mck
+    from tpu_paxos.harness import stress as strs
+
+    scope = mck.load_scopes()["quick"]
+    enum = mck.ScopeEnum(scope)
+    wl_rng = np.random.default_rng(scope.workload_seed)
+    workload, gates, _ = strs._workload(
+        scope.proposers, wl_rng, n_ids=scope.n_ids, n_free=scope.n_free
+    )
+    cfg = SimConfig(
+        n_nodes=scope.n_nodes,
+        n_instances=2 * sum(len(w) for w in workload),
+        proposers=tuple(range(scope.proposers)),
+        seed=0,
+        max_rounds=scope.max_rounds,
+    )
+    max_eps = max(scope.max_episodes, frun.MAX_EPISODES)
+    genv = geo.GeometryEnvelope(menu=((5, (0, 1)), (7, (0, 1, 2))))
+    r0 = env.runner_for(
+        cfg, workload, gates, max_episodes=max_eps, telemetry=True
+    )
+    rp = env.runner_for(
+        cfg, workload, gates, max_episodes=max_eps, telemetry=True,
+        geometry=genv,
+    )
+    chunk, n_real = mck.chunk_pad(enum.reduced, scope.chunk_lanes)[0]
+    scenarios = [enum.decode(i) for i in chunk]
+    seeds = [scope.seeds[sc.seed] for sc in scenarios]
+    scheds = [enum.schedule_of(sc) for sc in scenarios]
+    wls = [
+        (workload, gates if scope.gate_tiers[sc.gate] else None)
+        for sc in scenarios
+    ]
+    kns = [enum.faults_of(sc) for sc in scenarios]
+    rep0 = r0.run(seeds, scheds, workloads=wls, knobs=kns)
+    repp = rp.run(seeds, scheds, workloads=wls, knobs=kns,
+                  geometry=(cfg.n_nodes, cfg.proposers))
+
+    def nibbles(rep):
+        v = rep.verdict
+        return "".join(
+            f"{(bool(v.ok[i]) << 3) | (bool(v.agreement[i]) << 2) | (bool(v.coverage[i]) << 1) | bool(v.quiescent[i]):x}"
+            for i in range(n_real)
+        )
+
+    assert nibbles(rep0) == nibbles(repp)

@@ -62,6 +62,25 @@ class ProtocolConfig:
                 raise ValueError(f"{name} must be >= 1")
 
 
+#: Declared spans for the TRACED protocol knobs
+#: (core/geom.ProtocolKnobs).  A padded-envelope executable is shared
+#: across protocol-knob mixes, so a knob outside its declared span is
+#: rejected BY NAME at encode time (geom.protocol_knobs) instead of
+#: silently running a configuration the envelope was never validated
+#: for.  ``stall_patience`` is the idle-liveness restart patience
+#: (sim.IDLE_RESTART_ROUNDS is the compile-time default).
+PROTOCOL_SPANS: dict = {
+    "prepare_delay_min": (0, 64),
+    "prepare_delay_max": (0, 64),
+    "prepare_retry_count": (1, 64),
+    "prepare_retry_timeout": (1, 256),
+    "accept_retry_count": (1, 64),
+    "accept_retry_timeout": (1, 256),
+    "commit_retry_timeout": (1, 256),
+    "stall_patience": (1, 1024),
+}
+
+
 def _matrix(field: str, m, n: int | None) -> tuple:
     """Canonicalize one per-edge table to a square tuple-of-tuples of
     ints; ``n`` (if known) pins the side length."""

@@ -1,0 +1,294 @@
+"""Geometry-padded envelopes: ONE compiled executable for every
+tenant geometry on the menu.
+
+The reference serves any cluster geometry from one binary — its
+protocol constants (``paxos::Config``, ref multi/paxos.h:251-274) and
+its node count are plain runtime values.  Before this module our
+envelope cache still keyed on ``(n_nodes, proposers, protocol)``, so
+a service hosting 3-, 5-, and 7-node tenants compiled one executable
+per geometry.  Here the node/proposer axes of ``SimState`` are PADDED
+to an envelope bound and the true geometry arrives as runtime data:
+
+- :class:`GeometryEnvelope` — the static compile-time fact: a MENU of
+  ``(n_nodes, proposers)`` entries and the bound shapes they pad to.
+  Part of the engine closure and the envelope cache key.
+- :class:`Geometry` — the traced per-dispatch fact: which menu entry
+  this run is, plus the masks/indices the round function needs
+  (node_mask, proposer->node map, quorum, crash room).  Absent nodes
+  are permanently masked: never sampled, never quorum-counted, never
+  send or receive — the same exact-at-zero masked-form discipline as
+  the runtime fault knobs (core/net.FaultKnobs).
+- :class:`ProtocolKnobs` — the remaining compile-time protocol
+  constants (retry patience, backoff spans, commit-ladder stall
+  patience) promoted to traced int32 scalars threaded through
+  ``round_fn``.  ``static_protocol`` returns the same field set as
+  plain Python ints, so the degenerate (unpadded) engine traces the
+  byte-identical pre-envelope program.
+
+Why a MENU and not just a bound: jax's threefry bits are
+shape-dependent — ``randint(key, (5,))`` is NOT a prefix of
+``randint(key, (7,))`` — so an engine that sampled its fault coins at
+the bound shape would fork every true geometry's coins and break
+decision-log parity with the unpadded build.  Every PRNG draw whose
+shape depends on the geometry is therefore dispatched through
+``lax.switch`` over the menu (:func:`menu_randint`; the engine does
+the same for its per-edge copy plans): branch ``m`` draws at entry
+``m``'s TRUE static shape — bit-identical to the unpadded engine —
+and pads the result to the bound with values that provably never
+matter (a crash coin of 1e6 never crashes; a pad proposer's backoff
+is never consulted).  Decision-log sha256 parity between the padded
+and unpadded builds is pinned per (cfg, schedule, seed) by
+tests/test_envelope_pad.py.
+
+True nodes are always ids ``0..n-1`` (a menu entry's node set is a
+prefix of the bound's), so fault schedules, churn tables, and knob
+matrices encoded at the bound width carry the true geometry's values
+in their leading block and zeros beyond it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_paxos.config import PROTOCOL_SPANS, ProtocolConfig, SimConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class GeometryEnvelope:
+    """The static geometry menu one padded executable serves.
+
+    ``menu`` is a tuple of ``(n_nodes, proposers)`` entries; the
+    engine pads every [A]/[P]-shaped array to ``bound_nodes`` /
+    ``bound_proposers`` (the menu maxima) and ``lax.switch``es its
+    shape-dependent PRNG draws over the entries.  Hashable by
+    construction: it is an envelope-cache key component."""
+
+    menu: tuple
+
+    def __post_init__(self) -> None:
+        entries = []
+        for entry in self.menu:
+            n, props = entry
+            n = int(n)
+            props = tuple(sorted({int(x) for x in props})) or (0,)
+            if n < 1:
+                raise ValueError("menu entry needs n_nodes >= 1")
+            for x in props:
+                if not 0 <= x < n:
+                    raise ValueError(
+                        f"menu entry ({n}, {props}): proposer {x} out "
+                        "of range"
+                    )
+            entries.append((n, props))
+        if not entries:
+            raise ValueError("a GeometryEnvelope needs at least one entry")
+        if len(set(entries)) != len(entries):
+            raise ValueError("menu entries must be distinct")
+        object.__setattr__(self, "menu", tuple(entries))
+
+    @property
+    def bound_nodes(self) -> int:
+        return max(n for n, _ in self.menu)
+
+    @property
+    def bound_proposers(self) -> int:
+        return max(len(props) for _, props in self.menu)
+
+    def bound_cfg(self, cfg: SimConfig) -> SimConfig:
+        """``cfg`` re-shaped onto this envelope's bound: ``n_nodes``
+        raised to the node bound and ``proposers`` widened to
+        ``bound_proposers`` distinct slots (the slot->node map is
+        runtime data — :class:`Geometry` — so which nodes the bound
+        cfg names is immaterial; it only sizes the [P] axis)."""
+        return dataclasses.replace(
+            cfg,
+            n_nodes=self.bound_nodes,
+            proposers=tuple(range(self.bound_proposers)),
+        )
+
+    def index_of(self, n_nodes: int, proposers) -> int:
+        """Menu index of a true geometry, with NAMED rejections: a
+        geometry past the bound is rejected as such (the fleet-runner
+        contract), anything else missing as not on the menu."""
+        entry = (
+            int(n_nodes),
+            tuple(sorted({int(x) for x in proposers})) or (0,),
+        )
+        if entry in self.menu:
+            return self.menu.index(entry)
+        if entry[0] > self.bound_nodes or len(entry[1]) > self.bound_proposers:
+            raise ValueError(
+                f"geometry {entry} exceeds the envelope geometry bound "
+                f"({self.bound_nodes} nodes, {self.bound_proposers} "
+                "proposers)"
+            )
+        raise ValueError(
+            f"geometry {entry} is not in the envelope menu {self.menu}"
+        )
+
+    def index_of_nodes(self, n_nodes: int) -> int:
+        """Menu index by NODE COUNT alone — the membership engine's
+        lookup (member/ has no static proposer axis; every node may
+        propose through its view).  First menu entry with that node
+        count wins; same named rejections as :meth:`index_of`."""
+        n = int(n_nodes)
+        for i, (n_m, _) in enumerate(self.menu):
+            if n_m == n:
+                return i
+        if n > self.bound_nodes:
+            raise ValueError(
+                f"geometry ({n} nodes) exceeds the envelope geometry "
+                f"bound ({self.bound_nodes} nodes)"
+            )
+        raise ValueError(
+            f"geometry ({n} nodes) is not in the envelope menu "
+            f"{self.menu}"
+        )
+
+
+class Geometry(NamedTuple):
+    """The traced per-dispatch geometry of one padded run (broadcast
+    across a fleet's lanes).  Built host-side by :func:`geometry_for`;
+    every field is data, so changing tenant geometry costs a dispatch,
+    not a compile."""
+
+    geom_idx: jax.Array  # int32 menu index (the lax.switch selector)
+    n_true: jax.Array  # int32 true node count
+    quorum: jax.Array  # int32 n_true // 2 + 1
+    max_crash: jax.Array  # int32 (n_true - 1) // 2 crash-injection room
+    node_mask: jax.Array  # [A_bound] bool: ids < n_true
+    pn: jax.Array  # [P_bound] int32 proposer slot -> node id (pad: 0)
+    prop_mask: jax.Array  # [P_bound] bool: true proposer slots
+
+
+class ProtocolKnobs(NamedTuple):
+    """The protocol liveness constants as TRACED int32 scalars — the
+    reference's ``paxos::Config`` values as runtime data, so a
+    protocol-knob sweep shares one executable.  ``static_protocol``
+    mirrors the field set with plain Python ints for the degenerate
+    compile-time path."""
+
+    prepare_delay_min: jax.Array
+    prepare_delay_max: jax.Array
+    prepare_retry_count: jax.Array
+    prepare_retry_timeout: jax.Array
+    accept_retry_count: jax.Array
+    accept_retry_timeout: jax.Array
+    commit_retry_timeout: jax.Array
+    stall_patience: jax.Array
+
+
+def geometry_for(
+    env: GeometryEnvelope, n_nodes: int, proposers
+) -> Geometry:
+    """Host-side :class:`Geometry` for one true geometry of ``env``
+    (named rejection via ``env.index_of`` when it is off the menu)."""
+    idx = env.index_of(n_nodes, proposers)
+    n, props = env.menu[idx]
+    a, p = env.bound_nodes, env.bound_proposers
+    pn = np.zeros((p,), np.int32)
+    pn[: len(props)] = props
+    return Geometry(
+        geom_idx=np.int32(idx),
+        n_true=np.int32(n),
+        quorum=np.int32(n // 2 + 1),
+        max_crash=np.int32((n - 1) // 2),
+        node_mask=np.arange(a) < n,
+        pn=pn,
+        prop_mask=np.arange(p) < len(props),
+    )
+
+
+def protocol_knobs(
+    pc: ProtocolConfig, stall_patience: int = 8
+) -> ProtocolKnobs:
+    """Host-side traced-knob encoding of a ProtocolConfig, span-checked
+    against the DECLARED spans (config.PROTOCOL_SPANS): the compiled
+    program is shared across knob mixes, so an out-of-span knob must
+    be rejected by name, never silently clamped.  ``stall_patience``
+    is the idle-liveness restart patience (sim.IDLE_RESTART_ROUNDS is
+    the compile-time default)."""
+    values = {
+        "prepare_delay_min": pc.prepare_delay_min,
+        "prepare_delay_max": pc.prepare_delay_max,
+        "prepare_retry_count": pc.prepare_retry_count,
+        "prepare_retry_timeout": pc.prepare_retry_timeout,
+        "accept_retry_count": pc.accept_retry_count,
+        "accept_retry_timeout": pc.accept_retry_timeout,
+        "commit_retry_timeout": pc.commit_retry_timeout,
+        "stall_patience": int(stall_patience),
+    }
+    for name, v in values.items():
+        lo, hi = PROTOCOL_SPANS[name]
+        if not lo <= int(v) <= hi:
+            raise ValueError(
+                f"protocol knob {name}={v} is outside its declared "
+                f"span [{lo}, {hi}] (config.PROTOCOL_SPANS)"
+            )
+    return ProtocolKnobs(**{k: np.int32(v) for k, v in values.items()})
+
+
+def static_protocol(
+    pc: ProtocolConfig, stall_patience: int = 8
+) -> ProtocolKnobs:
+    """The same field set as plain Python ints — the compile-time
+    constants of the degenerate (non-runtime-protocol) engine.  Using
+    one accessor object for both paths keeps the round function free
+    of per-site forks; closing over Python ints traces the
+    byte-identical pre-envelope program."""
+    return ProtocolKnobs(
+        prepare_delay_min=pc.prepare_delay_min,
+        prepare_delay_max=pc.prepare_delay_max,
+        prepare_retry_count=pc.prepare_retry_count,
+        prepare_retry_timeout=pc.prepare_retry_timeout,
+        accept_retry_count=pc.accept_retry_count,
+        accept_retry_timeout=pc.accept_retry_timeout,
+        commit_retry_timeout=pc.commit_retry_timeout,
+        stall_patience=int(stall_patience),
+    )
+
+
+def menu_lengths(env: GeometryEnvelope, axis: str) -> list[int]:
+    """Per-menu-entry TRUE length along one padded axis."""
+    if axis == "nodes":
+        return [n for n, _ in env.menu]
+    if axis == "proposers":
+        return [len(props) for _, props in env.menu]
+    raise ValueError(f"unknown padded axis {axis!r}")
+
+
+def menu_randint(
+    env: GeometryEnvelope,
+    geom_idx: jax.Array,
+    key: jax.Array,
+    axis: str,
+    lo,
+    hi,
+    pad_value: int,
+):
+    """Menu-switched 1-D ``randint``: branch ``m`` draws at entry
+    ``m``'s TRUE static length along ``axis`` (threefry bits are
+    shape-dependent — the bit-exactness anchor of the whole padding
+    scheme) and pads to the bound with ``pad_value``.  ``lo``/``hi``
+    may be traced scalars: with bound values equal to the static ones
+    the draw is bit-identical (randint's bits depend only on
+    key/shape/dtype)."""
+    bound = env.bound_nodes if axis == "nodes" else env.bound_proposers
+
+    def _branch(n_m: int):
+        def _b(k):
+            v = jax.random.randint(k, (n_m,), lo, hi, dtype=jnp.int32)
+            return jnp.full((bound,), pad_value, jnp.int32).at[:n_m].set(v)
+
+        return _b
+
+    return jax.lax.switch(
+        geom_idx,
+        [_branch(n_m) for n_m in menu_lengths(env, axis)],
+        key,
+    )

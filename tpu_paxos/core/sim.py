@@ -98,6 +98,7 @@ from tpu_paxos.analysis import tracecount
 from tpu_paxos.config import FaultConfig, SimConfig
 from tpu_paxos.core import ballot as bal
 from tpu_paxos.core import faults as fltm
+from tpu_paxos.core import geom as geo
 from tpu_paxos.core import net as netm
 from tpu_paxos.core import values as val
 from tpu_paxos.utils import prng
@@ -261,19 +262,32 @@ class SimResult:
         }
 
 
-def _init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
+def _init_state(
+    cfg: SimConfig, pend, gate, tail, root: jax.Array,
+    geometry=None, geom=None, pknobs=None,
+) -> SimState:
     a, i = cfg.n_nodes, cfg.n_instances
     p = len(cfg.proposers)
     c = pend.shape[1]
     s = cfg.faults.max_delay + 2
     k0 = prng.stream(root, prng.STREAM_PREPARE_DELAY, 0)
-    delay0 = jax.random.randint(
-        k0,
-        (p,),
-        cfg.protocol.prepare_delay_min,
-        cfg.protocol.prepare_delay_max + 1,
-        dtype=jnp.int32,
+    lo = (
+        cfg.protocol.prepare_delay_min if pknobs is None
+        else pknobs.prepare_delay_min
     )
+    hi = (
+        cfg.protocol.prepare_delay_max if pknobs is None
+        else pknobs.prepare_delay_max
+    )
+    if geometry is None:
+        delay0 = jax.random.randint(k0, (p,), lo, hi + 1, dtype=jnp.int32)
+    else:
+        # menu-switched initial backoff: the same bit-exactness
+        # contract as the in-round draws (core/geom.menu_randint)
+        delay0 = geo.menu_randint(
+            geometry, geom.geom_idx, k0, "proposers", lo, hi + 1,
+            pad_value=0,
+        )
     none = lambda *sh: jnp.full(sh, bal.NONE, jnp.int32)  # noqa: E731
     return SimState(
         t=jnp.int32(0),
@@ -406,6 +420,8 @@ def build_engine(
     runtime_knobs: bool = False,
     telemetry: bool = False,
     window_rounds: int = 0,
+    geometry: "geo.GeometryEnvelope | None" = None,
+    runtime_protocol: bool = False,
 ):
     """Compile-time closure: returns ``round_fn(root_key, state) ->
     state`` plus static geometry.  Everything data-dependent lives in
@@ -478,17 +494,57 @@ def build_engine(
     updates are functions of replicated network arrivals and these
     global reductions, so every shard computes identical copies (the
     sharded-vs-unsharded equivalence test pins this).
+
+    With ``geometry`` set (a :class:`geom.GeometryEnvelope`), ``cfg``
+    must be the envelope's BOUND shape (``geometry.bound_cfg``): every
+    [A]/[P]-shaped array pads to the bound and the TRUE geometry
+    arrives per call as a traced :class:`geom.Geometry` —
+    ``round_fn(..., geom=Geometry)``.  Absent nodes are permanently
+    masked (never sampled, never quorum-counted, never send or
+    receive: the exact-at-zero masked-form discipline of the runtime
+    fault knobs), and every PRNG draw whose shape depends on the
+    geometry dispatches through ``lax.switch`` over the menu so each
+    true geometry's coins are bit-identical to its unpadded build
+    (threefry bits are shape-dependent — see core/geom.py; sha256
+    parity pinned by tests/test_envelope_pad.py).  ``geometry=None``
+    traces the byte-identical pre-envelope program.
+
+    With ``runtime_protocol=True`` the protocol liveness constants
+    (retry ladders, backoff spans, commit-ladder stall patience) are
+    NOT baked in: ``round_fn(..., pknobs=ProtocolKnobs)`` takes them
+    as traced int32 scalars (geom.protocol_knobs — span-checked
+    against config.PROTOCOL_SPANS).  Exact: randint's bits depend
+    only on key/shape/dtype, so traced delay spans draw the same
+    values as static ones, and every comparison/arithmetic use is
+    elementwise on the traced scalar.
     """
     a, i_cap = cfg.n_nodes, cfg.n_instances
     p = len(cfg.proposers)
     c = n_pend_cap
-    quorum = cfg.quorum
     pc, fc = cfg.protocol, cfg.faults
-    pn = jnp.asarray(cfg.proposers, jnp.int32)  # [P] proposer -> node
+    # Static geometry of the degenerate path; under a GeometryEnvelope
+    # the round function shadows these with the traced Geometry's
+    # fields (same names, so every use-site is fork-free).
+    _quorum0 = cfg.quorum
+    _pn0 = jnp.asarray(cfg.proposers, jnp.int32)  # [P] proposer -> node
+    _max_crash0 = (a - 1) // 2
+    if geometry is not None:
+        if not isinstance(geometry, geo.GeometryEnvelope):
+            raise TypeError("geometry must be a GeometryEnvelope or None")
+        if a != geometry.bound_nodes or p != geometry.bound_proposers:
+            raise ValueError(
+                f"a geometry-padded engine must be built at the "
+                f"envelope bound ({geometry.bound_nodes} nodes, "
+                f"{geometry.bound_proposers} proposers); cfg has "
+                f"({a}, {p}) — use geometry.bound_cfg(cfg)"
+            )
+    # Protocol constants: one accessor for both paths — plain Python
+    # ints (byte-identical degenerate program) or the traced
+    # ProtocolKnobs passed per call.
+    _pk0 = geo.static_protocol(pc, stall_patience=IDLE_RESTART_ROUNDS)
     if i_cap % n_shards:
         raise ValueError(f"n_instances {i_cap} not divisible by {n_shards}")
     i_loc = i_cap // n_shards  # instances per shard ([I]-axis array size)
-    max_crash = (a - 1) // 2
     # Seeded-wedge selection happens at BUILD time so the engine's
     # traced program is fixed per closure (see seeded_wedge()).
     _wedge_no_takeover = seeded_wedge() == "takeover"
@@ -627,7 +683,8 @@ def build_engine(
         return jnp.any(b)
 
     def round_fn(
-        root: jax.Array, st: SimState, tab=None, knobs=None, tele=None
+        root: jax.Array, st: SimState, tab=None, knobs=None, tele=None,
+        geom=None, pknobs=None,
     ):
         if runtime_schedule and tab is None:
             raise TypeError(
@@ -644,6 +701,27 @@ def build_engine(
                 "this engine was built with telemetry=True; round_fn "
                 "needs a Telemetry accumulator argument"
             )
+        if (geometry is not None) != (geom is not None):
+            raise TypeError(
+                "a GeometryEnvelope engine takes its Geometry per "
+                "call (round_fn geom=); a bound-free engine takes "
+                "none"
+            )
+        if runtime_protocol and pknobs is None:
+            raise TypeError(
+                "this engine was built with runtime_protocol=True; "
+                "round_fn needs a ProtocolKnobs argument"
+            )
+        # Geometry + protocol accessors: the degenerate bindings are
+        # the build-time constants, so geometry=None and
+        # runtime_protocol=False trace the byte-identical
+        # pre-envelope program.
+        pn = _pn0 if geom is None else geom.pn
+        quorum = _quorum0 if geom is None else geom.quorum
+        max_crash = _max_crash0 if geom is None else geom.max_crash
+        node_mask = None if geom is None else geom.node_mask
+        prop_mask = None if geom is None else geom.prop_mask
+        pk = _pk0 if pknobs is None else pknobs
         # queue rows must be pre-padded by the window width (see
         # prepare_queues) so window ops are copy-free dynamic slices.
         # ValueError, not assert: this is trace-time-only (zero runtime
@@ -701,9 +779,19 @@ def build_engine(
         # `st.crashed` alone — a paused node's obligations are only
         # deferred, never waived.
         alive_a = ~st.crashed  # [A]
+        if node_mask is not None:
+            # absent nodes: permanently dead for ALL I/O and timers
+            # (and, unlike crashes below, excused from every
+            # obligation via dead_a)
+            alive_a = alive_a & node_mask
         if paused_t is not None:
             alive_a = alive_a & ~paused_t
         prop_alive = alive_a[pn]  # [P]
+        if prop_mask is not None:
+            # pad proposer slots gather node 0's aliveness through
+            # pn's 0-padding — mask them out so they never start,
+            # resend, restart, or take over
+            prop_alive = prop_alive & prop_mask
 
         # Per-edge reachability cuts ANDed into every send mask below
         # (send-time semantics: copies already in the calendars still
@@ -734,25 +822,84 @@ def build_engine(
         # inflation composes per edge as src + dst slowness, clamped
         # at the ring bound inside copy_plan.
         kn_eff = knobs if runtime_knobs else static_mknobs
-        if kn_eff is not None:
-            aidx_n = jnp.arange(a)
-            kn_pa = netm.edge_knobs(kn_eff, pn, aidx_n)
-            kn_ap = netm.edge_knobs(kn_eff, aidx_n, pn)
-        else:
-            kn_pa = kn_ap = None
-        if gray_t is not None:
-            gray_pa = gray_t[pn][:, None] + gray_t[None, :]  # [P, A]
-            gray_ap = gray_t[:, None] + gray_t[pn][None, :]  # [A, P]
-        else:
-            gray_pa = gray_ap = None
+        if geom is None:
+            if kn_eff is not None:
+                aidx_n = jnp.arange(a)
+                kn_pa = netm.edge_knobs(kn_eff, pn, aidx_n)
+                kn_ap = netm.edge_knobs(kn_eff, aidx_n, pn)
+            else:
+                kn_pa = kn_ap = None
+            if gray_t is not None:
+                gray_pa = gray_t[pn][:, None] + gray_t[None, :]  # [P, A]
+                gray_ap = gray_t[:, None] + gray_t[pn][None, :]  # [A, P]
+            else:
+                gray_pa = gray_ap = None
 
-        def _plan(key, edge_shape, pa):
-            return netm.copy_plan(
-                key, edge_shape, fc, extra_drop=xdrop_t,
-                knobs=kn_pa if pa else kn_ap,
-                gray=gray_pa if pa else gray_ap,
-                delay_bound=fc.max_delay,
-            )
+            def _plan(key, edge_shape, pa):
+                return netm.copy_plan(
+                    key, edge_shape, fc, extra_drop=xdrop_t,
+                    knobs=kn_pa if pa else kn_ap,
+                    gray=gray_pa if pa else gray_ap,
+                    delay_bound=fc.max_delay,
+                )
+        else:
+            # Menu-switched copy plans: threefry bits are
+            # shape-dependent, so branch m samples at menu entry m's
+            # TRUE edge shape — bit-identical to the unpadded engine —
+            # with the knob matrices / gray vectors statically sliced
+            # to the entry's node prefix and proposer map, then pads
+            # the plan to the bound with dead copies (alive=False,
+            # delay=0: the pad region is never sent into anyway).
+            def _plan(key, edge_shape, pa):
+                def _branch(n_m, props_m):
+                    p_m = len(props_m)
+                    pn_m = jnp.asarray(props_m, jnp.int32)
+
+                    def _b(k):
+                        if kn_eff is not None:
+                            ai_m = jnp.arange(n_m)
+                            kn_m = (
+                                netm.edge_knobs(kn_eff, pn_m, ai_m) if pa
+                                else netm.edge_knobs(kn_eff, ai_m, pn_m)
+                            )
+                        else:
+                            kn_m = None
+                        if gray_t is not None:
+                            if pa:
+                                gr_m = (
+                                    gray_t[pn_m][:, None]
+                                    + gray_t[None, :n_m]
+                                )
+                            else:
+                                gr_m = (
+                                    gray_t[:n_m, None]
+                                    + gray_t[pn_m][None, :]
+                                )
+                        else:
+                            gr_m = None
+                        shp = (p_m, n_m) if pa else (n_m, p_m)
+                        al_m, dl_m = netm.copy_plan(
+                            k, shp, fc, extra_drop=xdrop_t, knobs=kn_m,
+                            gray=gr_m, delay_bound=fc.max_delay,
+                        )
+                        al_f = jnp.zeros(
+                            (netm.MAX_COPIES, *edge_shape), jnp.bool_
+                        )
+                        dl_f = jnp.zeros(
+                            (netm.MAX_COPIES, *edge_shape), jnp.int32
+                        )
+                        r, co = (p_m, n_m) if pa else (n_m, p_m)
+                        al_f = al_f.at[:, :r, :co].set(al_m)
+                        dl_f = dl_f.at[:, :r, :co].set(dl_m)
+                        return al_f, dl_f
+
+                    return _b
+
+                return jax.lax.switch(
+                    geom.geom_idx,
+                    [_branch(n_m, pr_m) for n_m, pr_m in geometry.menu],
+                    key,
+                )
 
         keys = jax.random.split(prng.stream(root, prng.STREAM_NET_DROP, t), 8)
 
@@ -1012,10 +1159,10 @@ def build_engine(
         )
         mode = jnp.where(now_prepared, PREPARED, pr.mode)
         acc_retries = jnp.where(
-            now_prepared, pc.accept_retry_count, pr.acc_retries
+            now_prepared, pk.accept_retry_count, pr.acc_retries
         )
         acc_deadline = jnp.where(
-            now_prepared, t + 1 + pc.accept_retry_timeout, pr.acc_deadline
+            now_prepared, t + 1 + pk.accept_retry_timeout, pr.acc_deadline
         )
 
         # New-value assignment for every PREPARED proposer: gate-ready
@@ -1252,9 +1399,13 @@ def build_engine(
             # the only [P, A, I] pass left on the commit path, paid
             # only when a reply arrives (or every round under crash
             # faults, where excusal can clear it without any arrival).
+            excused = (
+                st.crashed if node_mask is None
+                else st.crashed | ~node_mask
+            )
             wait = gany(jnp.any(
                 (commit_vid != val.NONE)
-                & ~jnp.all(ca | st.crashed[None, :, None], axis=1),
+                & ~jnp.all(ca | excused[None, :, None], axis=1),
                 axis=1,
             ))  # [P]
             return ca, wait
@@ -1292,7 +1443,7 @@ def build_engine(
         # PULL their gaps each round.)
         take_commit = (
             (pr.mode == PREPARED)
-            & (pr.stall >= IDLE_RESTART_ROUNDS)
+            & (pr.stall >= pk.stall_patience)
             & prop_alive
         )
         if _wedge_no_takeover:
@@ -1326,7 +1477,7 @@ def build_engine(
         resend_c = (t >= pr.commit_deadline) & commit_wait  # [P]
         send_commit = (any_newly | resend_c | (take_commit & commit_wait)) & prop_alive
         commit_deadline = jnp.where(
-            send_commit, t + 1 + pc.commit_retry_timeout, pr.commit_deadline
+            send_commit, t + 1 + pk.commit_retry_timeout, pr.commit_deadline
         )
 
         # Conflict re-proposal + own-value completion
@@ -1495,7 +1646,7 @@ def build_engine(
         restart_p = pdl & (pr.prep_retries <= 1)
         prep_retries = jnp.where(resend_prep, pr.prep_retries - 1, pr.prep_retries)
         prep_deadline = jnp.where(
-            resend_prep, t + 1 + pc.prepare_retry_timeout, pr.prep_deadline
+            resend_prep, t + 1 + pk.prepare_retry_timeout, pr.prep_deadline
         )
 
         # Accept deadline: resend outstanding then AcceptRejected ->
@@ -1525,18 +1676,29 @@ def build_engine(
         # of the previous round) has run out of patience.
         idle_restart = (
             (mode == PREPARED)
-            & (pr.stall >= IDLE_RESTART_ROUNDS)
+            & (pr.stall >= pk.stall_patience)
             & prop_alive
         )
 
         do_restart = restart_p | acc_fail | idle_restart
-        rnd_delay = jax.random.randint(
-            prng.stream(root, prng.STREAM_PREPARE_DELAY, t + 1),
-            (p,),
-            pc.prepare_delay_min,
-            pc.prepare_delay_max + 1,
-            dtype=jnp.int32,
-        )
+        _kd = prng.stream(root, prng.STREAM_PREPARE_DELAY, t + 1)
+        if geom is None:
+            rnd_delay = jax.random.randint(
+                _kd,
+                (p,),
+                pk.prepare_delay_min,
+                pk.prepare_delay_max + 1,
+                dtype=jnp.int32,
+            )
+        else:
+            # menu-switched backoff draw (pad slots 0: a pad
+            # proposer's delay_until is never consulted — it can
+            # never restart)
+            rnd_delay = geo.menu_randint(
+                geometry, geom.geom_idx, _kd, "proposers",
+                pk.prepare_delay_min, pk.prepare_delay_max + 1,
+                pad_value=0,
+            )
         delay_until = jnp.where(do_restart, t + 1 + rnd_delay, pr.delay_until)
         mode = jnp.where(do_restart, DELAY, mode)
         promises2 = jnp.where(do_restart[:, None], False, promises2)
@@ -1553,9 +1715,9 @@ def build_engine(
         count = jnp.where(start_prep, ncount, pr.count)
         ballot = jnp.where(start_prep, nballot, pr.ballot)
         mode = jnp.where(start_prep, PREPARING, mode)
-        prep_retries = jnp.where(start_prep, pc.prepare_retry_count, prep_retries)
+        prep_retries = jnp.where(start_prep, pk.prepare_retry_count, prep_retries)
         prep_deadline = jnp.where(
-            start_prep, t + 1 + pc.prepare_retry_timeout, prep_deadline
+            start_prep, t + 1 + pk.prepare_retry_timeout, prep_deadline
         )
         promises2 = jnp.where(start_prep[:, None], False, promises2)
 
@@ -1599,6 +1761,14 @@ def build_engine(
         # pair also feeds the recorder's fault-layer counters
         # (_tsites) — reading values already computed, never sampling.
         edge_pa = (p, a)
+        # broadcast fan-out: the bound's full node set, restricted to
+        # the TRUE nodes under a geometry (decision-neutral — pad
+        # destinations never read their arrivals — but load-bearing
+        # for the telemetry offered counters and msgs parity)
+        bcast_a = (
+            jnp.ones((p, a), jnp.bool_) if node_mask is None
+            else jnp.broadcast_to(node_mask[None, :], (p, a))
+        )
         # [(alive, delay, post-cut mask, pre-cut mask, is_pa)] in MSG
         # order: the pre-cut mask exists so the recorder can count
         # copies lost at SEVERED edges (pre & ~post) — offered stays
@@ -1607,7 +1777,7 @@ def build_engine(
         _tsites = []
         # prepare requests
         al, dl = _plan(keys[0], edge_pa, True)
-        pre_prep = send_prep[:, None] & jnp.ones((p, a), jnp.bool_)
+        pre_prep = send_prep[:, None] & bcast_a
         m_prep = _cut_pa(pre_prep)
         _tsites.append((al, dl, m_prep, pre_prep, True))
         net = net._replace(
@@ -1640,7 +1810,7 @@ def build_engine(
         )
         # accepts: per-edge ballot (batch content read at delivery)
         al, dl = _plan(keys[3], edge_pa, True)
-        pre_acc = send_accept[:, None] & jnp.ones((p, a), jnp.bool_)
+        pre_acc = send_accept[:, None] & bcast_a
         m_acc = _cut_pa(pre_acc)
         _tsites.append((al, dl, m_acc, pre_acc, True))
         net = net._replace(
@@ -1662,7 +1832,7 @@ def build_engine(
         # commits: per-edge presence (content read at delivery from
         # the sender's write-once commit_vid)
         al, dl = _plan(keys[5], edge_pa, True)
-        pre_com = send_commit[:, None] & jnp.ones((p, a), jnp.bool_)
+        pre_com = send_commit[:, None] & bcast_a
         m_com = _cut_pa(pre_com)
         _tsites.append((al, dl, m_com, pre_com, True))
         net = net._replace(
@@ -1677,15 +1847,17 @@ def build_engine(
             com_rep=netm.write_flag(net.com_rep, t, al, dl, m_crep)
         )
 
-        # message counters (logical sends, pre-fault)
+        # message counters (logical sends, pre-fault); broadcast
+        # fan-out counts the TRUE node set under a geometry
+        na = a if geom is None else geom.n_true
         msgs = met.msgs + jnp.stack(
             [
-                jnp.sum(send_prep) * a,
+                jnp.sum(send_prep) * na,
                 jnp.sum(send_rep),
                 jnp.sum(send_rej),
-                jnp.sum(send_accept) * a,
+                jnp.sum(send_accept) * na,
                 jnp.sum(send_arep),
-                jnp.sum(send_commit) * a,
+                jnp.sum(send_commit) * na,
                 jnp.sum(send_crep),
             ]
         ).astype(jnp.int32)
@@ -1707,7 +1879,16 @@ def build_engine(
             # its own stream key, and a zero traced rate makes `want`
             # all-false — identical to the elided static branch.
             ku = prng.stream(root, prng.STREAM_CRASH, t)
-            u = jax.random.randint(ku, (a,), 0, 1_000_000)
+            if geom is None:
+                u = jax.random.randint(ku, (a,), 0, 1_000_000)
+            else:
+                # menu-switched crash coins; pad nodes draw the 1e6
+                # sentinel (never < any rate) so they can neither
+                # crash nor consume minority-cap room
+                u = geo.menu_randint(
+                    geometry, geom.geom_idx, ku, "nodes", 0, 1_000_000,
+                    pad_value=1_000_000,
+                )
             c_rate = (
                 jnp.asarray(knobs.crash_rate, jnp.int32)
                 if runtime_knobs else fc.crash_rate
@@ -1720,6 +1901,11 @@ def build_engine(
         # ---------------- quiescence ----------------
         alive2 = ~crashed
         palive2 = alive2[pn]
+        if prop_mask is not None:
+            palive2 = palive2 & prop_mask
+        # obligation excusal: crashed nodes — and, under a geometry,
+        # nodes absent from the true cluster
+        dead2 = crashed if node_mask is None else crashed | ~node_mask
         # Packed reductions: the naive formulation issues ~8 small
         # collectives here, two of them CHAINED (hole and learned
         # checks needed the global high-water mark first).  Counting
@@ -1779,7 +1965,7 @@ def build_engine(
         q_empty = ~jnp.any(palive2 & (q_pending > 0))
         own_none = ~jnp.any(palive2 & (own_n > 0))
         contiguous = n_chosen == hmax + 1
-        learned_ok = jnp.all((n_learned == hmax + 1) | crashed)
+        learned_ok = jnp.all((n_learned == hmax + 1) | dead2)
         done = q_empty & own_none & contiguous & learned_ok & (t > 0)
         if runtime_schedule:
             # Heal-then-converge with a TRACED horizon: the per-lane
@@ -1830,7 +2016,7 @@ def build_engine(
                 cur_batch=cur_batch,
                 acks=acks,
                 acc_deadline=jnp.where(
-                    resend_acc, t + 1 + pc.accept_retry_timeout, acc_deadline
+                    resend_acc, t + 1 + pk.accept_retry_timeout, acc_deadline
                 ),
                 acc_retries=acc_retries,
                 own_assign=own_assign,
@@ -1918,7 +2104,7 @@ def build_engine(
         )  # [I]
         full_ack = jnp.any(
             (commit_vid != val.NONE)
-            & jnp.all(commit_acked | crashed[None, :, None], axis=1),
+            & jnp.all(commit_acked | dead2[None, :, None], axis=1),
             axis=0,
         )  # [I]
         new_tele = _rec.Telemetry(
@@ -2131,11 +2317,18 @@ def gates_vid_cap(
     return max(int(np.max(w)) for w in workload if len(w)) + 1
 
 
-def init_state(cfg: SimConfig, pend, gate, tail, root: jax.Array) -> SimState:
+def init_state(
+    cfg: SimConfig, pend, gate, tail, root: jax.Array,
+    geometry=None, geom=None, pknobs=None,
+) -> SimState:
     """Public initial-state constructor (tests seed custom acceptor
-    state through this)."""
+    state through this).  With ``geometry``/``geom``/``pknobs`` set
+    (a padded-envelope build), the initial prepare-delay draw is
+    menu-switched and span-traced exactly like the engine's in-round
+    draws; ``cfg`` must then be the envelope's bound shape."""
     return _init_state(
-        cfg, jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), root
+        cfg, jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail), root,
+        geometry=geometry, geom=geom, pknobs=pknobs,
     )
 
 
@@ -2204,6 +2397,29 @@ def _run_loop_knobs(cfg: SimConfig, round_fn):
 
         def body(st):
             return round_fn(root, st, tab, knobs)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    return _go
+
+
+def _run_loop_envelope(cfg: SimConfig, round_fn):
+    """Whole-run driver for a geometry-padded ``runtime_schedule +
+    runtime_knobs + runtime_protocol`` engine: schedule, fault knobs,
+    TRUE geometry, and protocol knobs all arrive per call, so ONE
+    executable serves every (geometry, knob, schedule, seed) mix of
+    the envelope menu.  The IR audit traces this surface as
+    ``sim.run_rounds_envelope``."""
+
+    @jax.jit
+    def _go(root, state, tab, knobs, gm, pknobs):
+        def cond(st):
+            return (~st.done) & (
+                st.t < cfg.max_rounds + jnp.asarray(tab.horizon, jnp.int32)
+            )
+
+        def body(st):
+            return round_fn(root, st, tab, knobs, geom=gm, pknobs=pknobs)
 
         return jax.lax.while_loop(cond, body, state)
 
@@ -2530,6 +2746,58 @@ def audit_entries():
             (root, state, tele0),
         )
 
+    def build_envelope():
+        # The geometry-padded envelope surface: node/proposer axes
+        # padded to the menu bound, the TRUE geometry and the protocol
+        # constants as traced runtime inputs (geometry +
+        # runtime_protocol on top of the runtime schedule + knob
+        # path).  The menu-switched PRNG draws and the masked-absent
+        # node plumbing are in the traced program, so padding waste is
+        # a NAMED per-primitive budget breach, not silent drift;
+        # IR205's const budget watches that no geometry table bakes
+        # back in as a constant.
+        from tpu_paxos.fleet import schedule_table as stm
+
+        genv = geo.GeometryEnvelope(menu=((3, (0, 1)), (5, (0, 1, 2))))
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
+        )
+        bcfg = genv.bound_cfg(cfg)
+        # true workload rows padded to the proposer bound (empty row:
+        # pad slots never propose)
+        workload = default_workload(cfg) + [np.zeros((0,), np.int32)]
+        pend, gate, tail, c = prepare_queues(bcfg, workload, None)
+        root = prng.root_key(cfg.seed)
+        gm = geo.geometry_for(genv, cfg.n_nodes, cfg.proposers)
+        pkn = geo.protocol_knobs(
+            cfg.protocol, stall_patience=IDLE_RESTART_ROUNDS
+        )
+        state = init_state(
+            bcfg, pend, gate, tail, root,
+            geometry=genv, geom=gm, pknobs=pkn,
+        )
+        sched = fltm.FaultSchedule((
+            fltm.partition(2, 10, (0,), (1, 2)),
+            fltm.pause(3, 8, 2),
+        ))
+        tab = jax.tree.map(
+            jnp.asarray, stm.encode_schedule(sched, bcfg.n_nodes, 4)
+        )
+        knobs = jax.tree.map(jnp.asarray, netm.pad_matrix_knobs(
+            netm.matrix_knobs(cfg.faults, cfg.n_nodes), bcfg.n_nodes
+        ))
+        rf = build_engine(
+            bcfg, c, vid_cap=0, runtime_schedule=True,
+            runtime_knobs=True, geometry=genv, runtime_protocol=True,
+        )
+        return (
+            _run_loop_envelope(bcfg, rf),
+            (root, state, tab, knobs,
+             jax.tree.map(jnp.asarray, gm),
+             jax.tree.map(jnp.asarray, pkn)),
+        )
+
     def build_gates():
         # Gate-bearing config: a nonzero vid_cap puts the gate-
         # membership bitmap and the gated-admission logic in the
@@ -2579,6 +2847,11 @@ def audit_entries():
         ),
         AuditEntry(
             "sim.run_rounds_timeseries", build_timeseries,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
+        ),
+        AuditEntry(
+            "sim.run_rounds_envelope", build_envelope,
+            covers=("_run_loop_envelope",),
             allow=("IR204",), why=ir204_why, hlo_golden=True,
         ),
         AuditEntry(

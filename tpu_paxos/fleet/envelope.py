@@ -73,6 +73,7 @@ def envelope_key(
     delay_bound: int,
     mesh,
     telemetry: bool = False,
+    geometry=None,
 ) -> tuple:
     """The hashable envelope of a (cfg, workload-template) pair —
     exactly the static facts the compiled lane program depends on.
@@ -80,7 +81,14 @@ def envelope_key(
     different traced program (the recorder rides the loop carry).
     So is the seeded-wedge flag (core/sim.seeded_wedge): an armed
     build compiles the takeover OUT, and a cache hit across the flag
-    would silently run the wrong engine."""
+    would silently run the wrong engine.
+
+    Under a ``geometry`` envelope (core/geom.GeometryEnvelope) the
+    key COLLAPSES: ``cfg`` must already be the bound cfg, the menu
+    replaces the per-geometry (n_nodes, proposers) facts, and the
+    protocol-knob tuple drops out entirely (protocol knobs are traced
+    per-dispatch data of the padded engine) — one warm executable
+    then serves every (geometry on the menu x protocol mix)."""
     wl = [np.asarray(w, np.int32).reshape(-1) for w in workload]
     expected, owner = vdt.expected_owners(cfg, wl)
     gate_sig = (
@@ -96,7 +104,11 @@ def envelope_key(
         cfg.n_instances,
         cfg.assign_window,
         cfg.max_rounds,
-        dataclasses.astuple(cfg.protocol),
+        (
+            dataclasses.astuple(cfg.protocol)
+            if geometry is None else "runtime-protocol"
+        ),
+        None if geometry is None else ("geom", geometry.menu),
         int(delay_bound),
         int(max_episodes),
         tuple(len(w) for w in wl),
@@ -117,8 +129,17 @@ def runner_for(
     delay_bound: int | None = None,
     mesh=None,
     telemetry: bool = False,
+    geometry=None,
 ) -> frun.FleetRunner:
     """The shared compiled runner for ``cfg``'s envelope.
+
+    ``geometry`` (a core/geom.GeometryEnvelope) hands back the
+    geometry-PADDED runner of the envelope bound: ``cfg`` may name any
+    true geometry (it normalizes to ``geometry.bound_cfg``), the
+    workload template pads to the proposer bound, and the cache key
+    collapses over the menu and the protocol knobs — every tenant
+    geometry <= the bound shares ONE warm executable.  Dispatch with
+    ``run(geometry=(n_nodes, proposers), protocol=...)``.
 
     ``telemetry=True`` hands back the flight-recorder-armed twin of
     the envelope (its own cache slot: the recorder changes the traced
@@ -140,9 +161,27 @@ def runner_for(
             f"cfg max_delay {cfg.faults.max_delay} exceeds the "
             f"requested envelope delay bound {delay_bound}"
         )
+    if geometry is not None:
+        # normalize ONTO the envelope bound before keying: every true
+        # geometry <= the bound lands on the same cache slot (the
+        # bound cfg + the padded template are the compile facts; the
+        # per-dispatch true geometry is menu-checked by run())
+        if (
+            cfg.n_nodes > geometry.bound_nodes
+            or len(cfg.proposers) > geometry.bound_proposers
+        ):
+            raise ValueError(
+                f"geometry ({cfg.n_nodes}, {cfg.proposers}) exceeds "
+                f"the envelope geometry bound ({geometry.bound_nodes} "
+                f"nodes, {geometry.bound_proposers} proposers)"
+            )
+        cfg = geometry.bound_cfg(cfg)
+        workload, gates = frun._pad_geometry_workload(
+            workload, gates, geometry.bound_proposers
+        )
     key = envelope_key(
         cfg, workload, gates, max_episodes, delay_bound, mesh,
-        telemetry=telemetry,
+        telemetry=telemetry, geometry=geometry,
     )
     runner = _CACHE.get(key)
     if runner is None:
@@ -154,7 +193,7 @@ def runner_for(
         )
         runner = frun.FleetRunner(
             base, workload, gates, mesh=mesh, max_episodes=max_episodes,
-            telemetry=telemetry,
+            telemetry=telemetry, geometry=geometry,
         )
         # the MUST above is enforced: run() rejects implicit
         # workloads/knobs on cache-shared runners
@@ -299,6 +338,7 @@ def member_envelope_key(
     max_episodes: int,
     crash_rate: int,
     max_rounds: int,
+    geometry=None,
 ) -> tuple:
     """The hashable envelope of a membership fleet — exactly the
     static facts the compiled churn-lane program depends on: the
@@ -306,10 +346,16 @@ def member_envelope_key(
     fault-schedule episode capacity, the i.i.d. crash rate (a traced
     draw's presence is a compile-time fact in the member engine), and
     the round budget.  Everything else — seeds, churn scenarios,
-    episode mixes — is a runtime input of the cached executable."""
+    episode mixes — is a runtime input of the cached executable.
+    Under a ``geometry`` envelope the node count COLLAPSES to the
+    menu: one warm churn executable per bound, the true node count a
+    per-dispatch input."""
     return (
         "member",
-        int(n_nodes),
+        (
+            int(n_nodes) if geometry is None
+            else ("geom", geometry.menu)
+        ),
         int(n_instances),
         int(max_events),
         int(max_episodes),
@@ -326,26 +372,34 @@ def member_runner_for(
     max_episodes: int = frun.MAX_EPISODES,
     crash_rate: int = 0,
     max_rounds: int = 2000,
+    geometry=None,
 ):
     """The shared compiled membership-fleet runner for this envelope
     (``fleet/member_runner.MemberFleetRunner``), memoized in the same
     cache the sim envelopes share: distinct churn scenarios, episode
-    mixes, and seeds then cost dispatches, not compiles."""
+    mixes, and seeds then cost dispatches, not compiles.  With a
+    ``geometry`` envelope, ``n_nodes`` may be any menu node count (it
+    normalizes to the bound and is re-declared per dispatch:
+    ``run(n_nodes=...)``) and every geometry on the menu shares ONE
+    cached runner."""
     from tpu_paxos.fleet import member_runner as mrun
     from tpu_paxos.membership import churn_table as ctm
 
     if max_events is None:
         max_events = ctm.MAX_EVENTS
+    if geometry is not None:
+        geometry.index_of_nodes(n_nodes)  # named menu/bound rejection
+        n_nodes = geometry.bound_nodes
     key = member_envelope_key(
         n_nodes, n_instances, max_events, max_episodes, crash_rate,
-        max_rounds,
+        max_rounds, geometry=geometry,
     )
     runner = _CACHE.get(key)
     if runner is None:
         runner = mrun.MemberFleetRunner(
             n_nodes, n_instances, max_events=max_events,
             max_episodes=max_episodes, crash_rate=crash_rate,
-            max_rounds=max_rounds,
+            max_rounds=max_rounds, geometry=geometry,
         )
         _CACHE[key] = runner
     return runner

@@ -145,8 +145,10 @@ class MemberFleetReport:
     def lane_log(self, i: int) -> str:
         """One lane's canonical decision log — byte-equal to the
         single ``ChurnEngine.run`` of ``(churns[i], schedules[i],
-        seeds[i])`` (the parity contract)."""
-        return meng.decision_log_of(self.lane_state(i))
+        seeds[i])`` (the parity contract).  ``n_nodes`` is the
+        dispatch's TRUE node count, so a geometry-padded lane's log
+        lists applied[] rows only for nodes that exist."""
+        return meng.decision_log_of(self.lane_state(i), self.n_nodes)
 
 
 class MemberFleetRunner:
@@ -166,7 +168,15 @@ class MemberFleetRunner:
         crash_rate: int = 0,
         max_rounds: int = 2000,
         mesh=None,
+        geometry=None,
     ):
+        if geometry is not None and n_nodes != geometry.bound_nodes:
+            raise ValueError(
+                "a geometry-padded member fleet must be built at the "
+                f"envelope node bound ({geometry.bound_nodes}), got "
+                f"n_nodes={n_nodes}"
+            )
+        self.geometry = geometry
         self.n = n_nodes
         self.i = n_instances
         self.c = n_instances * 2 + 8
@@ -177,22 +187,25 @@ class MemberFleetRunner:
         self.mesh = mesh
         round_fn = meng._build_round(
             n_nodes, n_instances, self.c, crash_rate,
-            runtime_schedule=True,
+            runtime_schedule=True, geometry=geometry,
         )
         # the SAME whole-run loop ChurnEngine dispatches for single
         # runs — shared so the lane body can never drift from the
         # parity twin the tests compare against
         loop = meng._build_churn_loop(
-            round_fn, self.c, self.max_rounds, runtime_tables=True
+            round_fn, self.c, self.max_rounds, runtime_tables=True,
+            padded=geometry is not None,
         )
 
-        def lane(root, st, ctab, ftab):
-            final, cur, done = loop(root, st, ctab, ftab)
+        def lane(root, st, ctab, ftab, *gp):
+            final, cur, done = loop(root, st, ctab, ftab, *gp)
             return final, cur, member_lane_verdict(final, ctab, done)
 
         # the shared initial state broadcasts (in_axes=None): the [I]-
-        # sized arrays upload once, not per lane
-        fl = jax.vmap(lane, in_axes=(0, None, 0, 0))
+        # sized arrays upload once, not per lane; padded lanes carry a
+        # trailing [lanes] menu-index vector
+        in_axes = (0, None, 0, 0) + ((0,) if geometry is not None else ())
+        fl = jax.vmap(lane, in_axes=in_axes)
         if mesh is not None and mesh.size > 1:
             from tpu_paxos.parallel import mesh as pmesh
 
@@ -202,18 +215,38 @@ class MemberFleetRunner:
             # roots/tables/outputs split on the leading lane axis
             # (SH001: the specs come from parallel/, never hand-built)
             spec = pmesh.instance_spec(mesh)
+            in_specs = (spec, pmesh.replicated_spec(), spec, spec)
+            if geometry is not None:
+                in_specs = in_specs + (spec,)
             fl = pmesh.shard_map(
                 fl, mesh,
-                in_specs=(spec, pmesh.replicated_spec(), spec, spec),
+                in_specs=in_specs,
                 out_specs=spec,
             )
         self._fn = jax.jit(fl)
 
-    def run(self, seeds, churns, schedules) -> MemberFleetReport:
+    def run(self, seeds, churns, schedules, n_nodes=None) -> MemberFleetReport:
         """One fleet dispatch: ``seeds[i]``, ``churns[i]``
         (ChurnSchedule or None), and ``schedules[i]`` (FaultSchedule
-        or None) drive lane ``i``.  Returns once the verdict vector is
-        on the host; the per-lane states stay on device."""
+        or None) drive lane ``i``.  A geometry-padded runner takes the
+        dispatch's TRUE node count via ``n_nodes=`` (menu-checked by
+        name; churn events and schedules may only name true nodes).
+        Returns once the verdict vector is on the host; the per-lane
+        states stay on device."""
+        if self.geometry is None:
+            if n_nodes is not None:
+                raise ValueError(
+                    "n_nodes= is a geometry-padded dispatch input; "
+                    "build the runner with a GeometryEnvelope"
+                )
+            gidx = None
+        else:
+            if n_nodes is None:
+                raise ValueError(
+                    "a geometry-padded member fleet takes its TRUE "
+                    "node count per dispatch: run(n_nodes=...)"
+                )
+            gidx = self.geometry.index_of_nodes(n_nodes)
         seeds = [int(s) for s in seeds]
         churns = list(churns)
         schedules = list(schedules)
@@ -240,15 +273,20 @@ class MemberFleetRunner:
         st0 = meng._init(self.n, self.i, self.c)
         t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         with tracecount.engine_scope("member"):
-            final, cur, v = self._fn(
+            args = (
                 roots, st0,
                 jax.tree.map(jnp.asarray, ctabs),
                 jax.tree.map(jnp.asarray, ftabs),
             )
+            if gidx is not None:
+                args = args + (
+                    jnp.full((n_lanes,), gidx, jnp.int32),
+                )
+            final, cur, v = self._fn(*args)
         verdict = MemberLaneVerdict(*(np.asarray(x) for x in v))
         seconds = time.perf_counter() - t0  # verdict transfer = the sync  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         return MemberFleetReport(
-            n_nodes=self.n,
+            n_nodes=self.n if self.geometry is None else int(n_nodes),
             n_lanes=n_lanes,
             seeds=seeds,
             churns=churns,

@@ -49,6 +49,7 @@ import numpy as np
 
 from tpu_paxos.analysis import tracecount
 from tpu_paxos.config import EdgeFaultConfig, FaultConfig, SimConfig
+from tpu_paxos.core import geom as geo
 from tpu_paxos.core import net as netm
 from tpu_paxos.core import sim as simm
 from tpu_paxos.core import values as val
@@ -72,6 +73,26 @@ def default_lane_count(backend: str | None = None) -> int:
     if backend == "gpu":
         return 128
     return 8
+
+
+def _pad_geometry_workload(workload, gates, bound_p: int):
+    """Workload/gate rows padded with EMPTY rows to the envelope's
+    proposer bound: pad proposer slots own no values (their queues
+    drain vacuously), so vid sets, queue capacity, and the verdict
+    tables are untouched.  A workload naming more proposers than the
+    bound is rejected by name."""
+    workload = [np.asarray(w, np.int32) for w in workload]
+    if len(workload) > bound_p:
+        raise ValueError(
+            f"workload names {len(workload)} proposers; the envelope "
+            f"geometry bound is {bound_p} proposers"
+        )
+    pad = bound_p - len(workload)
+    wl = workload + [np.zeros((0,), np.int32)] * pad
+    g = None
+    if gates is not None:
+        g = list(gates) + [np.zeros((0,), np.int32)] * pad
+    return wl, g
 
 
 @dataclasses.dataclass
@@ -170,12 +191,29 @@ class FleetRunner:
         mesh=None,
         max_episodes: int = MAX_EPISODES,
         telemetry: bool = False,
+        geometry: geo.GeometryEnvelope | None = None,
     ):
         if cfg.faults.schedule is not None:
             raise ValueError(
                 "fleet base cfg must not bake a schedule; schedules "
                 "are per-lane runtime tables"
             )
+        if geometry is not None:
+            # padded runner: the build cfg IS the envelope bound; the
+            # true geometry + protocol knobs arrive per run() dispatch
+            if (
+                cfg.n_nodes != geometry.bound_nodes
+                or tuple(cfg.proposers)
+                != tuple(range(geometry.bound_proposers))
+            ):
+                raise ValueError(
+                    "a geometry-padded fleet runner must be built at "
+                    "the envelope bound; use geometry.bound_cfg(cfg)"
+                )
+            workload, gates = _pad_geometry_workload(
+                workload, gates, geometry.bound_proposers
+            )
+        self.geometry = geometry
         self.cfg = cfg
         self.workload = [np.asarray(w, np.int32) for w in workload]
         self.gates = gates
@@ -210,13 +248,20 @@ class FleetRunner:
             runtime_knobs=True,
             telemetry=telemetry,
             window_rounds=_telem.WINDOW_ROUNDS if telemetry else 0,
+            geometry=geometry,
+            runtime_protocol=geometry is not None,
         )
         vid_bound = self.vid_bound
 
+        # geometry-padded lanes carry two trailing [lanes]-stacked
+        # pytrees (Geometry, ProtocolKnobs); bound-free lanes carry
+        # none — the *gp splat keeps ONE lane body for both builds
         if telemetry:
             from tpu_paxos.telemetry import recorder as telem
 
-            def lane(root, st, tab, kn, exp, own, rmap):
+            def lane(root, st, tab, kn, exp, own, rmap, *gp):
+                gm, pkn = gp if gp else (None, None)
+
                 def cond(c):
                     return (~c[0].done) & (
                         c[0].t < cfg.max_rounds + tab.horizon
@@ -235,12 +280,17 @@ class FleetRunner:
                 )
                 final, (tl, ws) = jax.lax.while_loop(
                     cond,
-                    lambda c: round_fn(root, c[0], tab, kn, tele=c[1]),
+                    lambda c: round_fn(
+                        root, c[0], tab, kn, tele=c[1],
+                        geom=gm, pknobs=pkn,
+                    ),
                     (st, tele0),
                 )
                 return (
                     final,
-                    vdt.lane_verdict(cfg, final, exp, own, vid_cap=vid_bound),
+                    vdt.lane_verdict(
+                        cfg, final, exp, own, vid_cap=vid_bound, geom=gm
+                    ),
                     telem.summarize(tl, final, tab.horizon, rmap),
                     telem.summarize_windows(
                         ws, tl.admit_round, final.met.chosen_vid,
@@ -251,15 +301,21 @@ class FleetRunner:
                     ),
                 )
         else:
-            def lane(root, st, tab, kn, exp, own):
+            def lane(root, st, tab, kn, exp, own, *gp):
+                gm, pkn = gp if gp else (None, None)
+
                 def cond(s):
                     return (~s.done) & (s.t < cfg.max_rounds + tab.horizon)
 
                 final = jax.lax.while_loop(
-                    cond, lambda s: round_fn(root, s, tab, kn), st
+                    cond,
+                    lambda s: round_fn(
+                        root, s, tab, kn, geom=gm, pknobs=pkn
+                    ),
+                    st,
                 )
                 return final, vdt.lane_verdict(
-                    cfg, final, exp, own, vid_cap=vid_bound
+                    cfg, final, exp, own, vid_cap=vid_bound, geom=gm
                 )
 
         fl = jax.vmap(lane)
@@ -269,15 +325,25 @@ class FleetRunner:
             # lane-axis spec from the mesh module (SH001: axis names
             # route through parallel/, never hand-built here)
             spec = pmesh.instance_spec(mesh)
+            n_in = (7 if telemetry else 6) + (
+                2 if geometry is not None else 0
+            )
             fl = pmesh.shard_map(
                 fl, mesh,
-                in_specs=(spec,) * (7 if telemetry else 6),
+                in_specs=(spec,) * n_in,
                 out_specs=(spec,) * (4 if telemetry else 2),
             )
         self._fn = jax.jit(fl)
 
-        def init_lane(pend, gate, tail, root):
-            return simm.init_state(cfg, pend, gate, tail, root)
+        if geometry is None:
+            def init_lane(pend, gate, tail, root):
+                return simm.init_state(cfg, pend, gate, tail, root)
+        else:
+            def init_lane(pend, gate, tail, root, gm, pkn):
+                return simm.init_state(
+                    cfg, pend, gate, tail, root,
+                    geometry=geometry, geom=gm, pknobs=pkn,
+                )
 
         self._init = jax.jit(jax.vmap(init_lane))
 
@@ -291,7 +357,7 @@ class FleetRunner:
         po[: len(own)] = own
         return pe, po
 
-    def _queues(self, n_lanes: int, workloads):
+    def _queues(self, n_lanes: int, workloads, owner_cfg=None):
         """Stacked per-lane (pend, gate, tail, expected, owner) plus
         the per-lane expected-vid list.  Per-lane workloads must match
         the template's SHAPES (same per-proposer lengths, same queue
@@ -319,7 +385,7 @@ class FleetRunner:
         for wl_lane, g_lane in workloads:
             key = (id(wl_lane), id(g_lane))
             if key not in cache:
-                cache[key] = self._lane_tables(wl_lane, g_lane)
+                cache[key] = self._lane_tables(wl_lane, g_lane, owner_cfg)
             lanes.append(cache[key])
         return (
             stack([ln[0] for ln in lanes]), stack([ln[1] for ln in lanes]),
@@ -327,10 +393,17 @@ class FleetRunner:
             stack([ln[4] for ln in lanes]), [ln[5] for ln in lanes],
         )
 
-    def _lane_tables(self, wl_lane, g_lane):
+    def _lane_tables(self, wl_lane, g_lane, owner_cfg=None):
         """Validate one lane's (workload, gates) against the envelope
-        and return its (pend, gate, tail, expected, owner, exp)."""
-        exp, own = vdt.expected_owners(self.cfg, wl_lane)
+        and return its (pend, gate, tail, expected, owner, exp).
+        ``owner_cfg`` (geometry-padded dispatches) carries the TRUE
+        geometry the verdict's vid->owner-node map is computed
+        against; the queue tables themselves pad to the bound."""
+        exp, own = vdt.expected_owners(owner_cfg or self.cfg, wl_lane)
+        if self.geometry is not None:
+            wl_lane, g_lane = _pad_geometry_workload(
+                wl_lane, g_lane, self.geometry.bound_proposers
+            )
         if exp.size and int(exp.max()) >= self.vid_bound:
             raise ValueError(
                 f"per-lane workload vid {int(exp.max())} exceeds "
@@ -430,6 +503,11 @@ class FleetRunner:
                 )
             fcs.append(k)
         mats = [netm.matrix_knobs(fc, a) for fc in fcs]
+        if self.geometry is not None:
+            # true-size [n, n] edge tables pad to the bound with zeros
+            # (menu branches slice the TRUE leading block back out);
+            # scalar mixes already broadcast uniformly at the bound
+            mats = [netm.pad_matrix_knobs(m, a) for m in mats]
         stacked = netm.FaultKnobs(
             drop_rate=np.stack([m.drop_rate for m in mats]),
             dup_rate=np.stack([m.dup_rate for m in mats]),
@@ -451,6 +529,8 @@ class FleetRunner:
         workloads=None,
         knobs=None,
         regions=None,
+        geometry=None,
+        protocol=None,
     ) -> FleetReport:
         """One fleet dispatch: ``seeds[i]``, ``schedules[i]``
         (FaultSchedule or None), and ``knobs[i]`` (FaultConfig /
@@ -477,6 +557,44 @@ class FleetRunner:
                 "(fleet/envelope.runner_for): pass explicit workloads= "
                 "and knobs= — its template queues and base knob mix "
                 "are cache-normalized, not yours"
+            )
+        if self.geometry is None:
+            if geometry is not None or protocol is not None:
+                raise ValueError(
+                    "geometry=/protocol= are geometry-padded dispatch "
+                    "inputs; build the runner with a GeometryEnvelope "
+                    "(FleetRunner(geometry=...))"
+                )
+            gm_host = pkn_host = None
+            report_cfg = self.cfg
+        else:
+            if geometry is None:
+                raise ValueError(
+                    "a geometry-padded runner takes its TRUE geometry "
+                    "per dispatch: run(geometry=(n_nodes, proposers))"
+                )
+            if workloads is None:
+                raise ValueError(
+                    "a geometry-padded dispatch needs explicit "
+                    "workloads= (the verdict's vid->owner map is "
+                    "computed against the TRUE geometry, not the "
+                    "bound cfg)"
+                )
+            n_true, true_props = geometry
+            true_props = tuple(int(x) for x in true_props)
+            pc = protocol if protocol is not None else self.cfg.protocol
+            # named rejections: off-menu / over-bound geometries via
+            # GeometryEnvelope.index_of, out-of-span knobs via
+            # config.PROTOCOL_SPANS in geo.protocol_knobs
+            gm_host = geo.geometry_for(self.geometry, n_true, true_props)
+            pkn_host = geo.protocol_knobs(
+                pc, stall_patience=simm.IDLE_RESTART_ROUNDS
+            )
+            report_cfg = dataclasses.replace(
+                self.cfg,
+                n_nodes=int(n_true),
+                proposers=true_props,
+                protocol=pc,
             )
         seeds = [int(s) for s in seeds]
         schedules = list(schedules)
@@ -511,8 +629,19 @@ class FleetRunner:
                 )
         roots = jnp.stack([prng.root_key(s) for s in seeds])
         pend, gate, tail, exp, own, exp_list = self._queues(
-            n_lanes, workloads
+            n_lanes, workloads,
+            owner_cfg=None if self.geometry is None else report_cfg,
         )
+        if self.geometry is not None:
+            # one true geometry per dispatch, broadcast [lanes]-leading
+            # (views, not copies) so every lane axis — and the mesh
+            # tiling — sees uniformly stacked inputs
+            def _bl(x):
+                x = np.asarray(x)
+                return np.broadcast_to(x, (n_lanes,) + x.shape)
+
+            gm_lanes = jax.tree.map(_bl, gm_host)
+            pkn_lanes = jax.tree.map(_bl, pkn_host)
         if regions is not None and not self.telemetry:
             raise ValueError(
                 "regions maps feed the flight recorder's region-pair "
@@ -534,10 +663,16 @@ class FleetRunner:
         t0 = time.perf_counter()  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         tsum = wsum = None
         with tracecount.engine_scope("fleet"):
-            states = self._init(
+            init_args = (
                 jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail),
                 roots,
             )
+            if self.geometry is not None:
+                init_args = init_args + (
+                    jax.tree.map(jnp.asarray, gm_lanes),
+                    jax.tree.map(jnp.asarray, pkn_lanes),
+                )
+            states = self._init(*init_args)
             args = (
                 roots, states, tabs,
                 jax.tree.map(jnp.asarray, kn),
@@ -545,6 +680,11 @@ class FleetRunner:
             )
             if self.telemetry:
                 args = args + (jnp.asarray(rmaps),)
+            if self.geometry is not None:
+                args = args + (
+                    jax.tree.map(jnp.asarray, gm_lanes),
+                    jax.tree.map(jnp.asarray, pkn_lanes),
+                )
             out = self._fn(*args)
             if self.telemetry:
                 final, v, tsum, wsum = out
@@ -556,7 +696,7 @@ class FleetRunner:
             wsum = jax.tree.map(np.asarray, wsum)
         seconds = time.perf_counter() - t0  # verdict transfer = the sync  # paxlint: allow[DET001] lanes/sec metric only; never reaches artifacts
         return FleetReport(
-            cfg=self.cfg,
+            cfg=report_cfg,
             n_lanes=n_lanes,
             seeds=seeds,
             schedules=schedules,
@@ -642,6 +782,68 @@ def audit_entries():
             )
         return runner._fn, args
 
+    def _genv():
+        # the canonical audit geometry (3 nodes, 2 proposers) one menu
+        # step below a 5-node / 3-proposer bound — the smallest
+        # envelope whose padding is visible in every padded axis
+        return geo.GeometryEnvelope(menu=((3, (0, 1)), (5, (0, 1, 2))))
+
+    def _build_envelope(mesh=None, n_lanes: int = 2):
+        import dataclasses as dc
+
+        cfg = _audit_cfg()
+        genv = _genv()
+        workload = simm.default_workload(cfg)
+        runner = FleetRunner(
+            genv.bound_cfg(cfg), workload, max_episodes=2,
+            geometry=genv, mesh=mesh,
+        )
+        scheds = _audit_scheds(n_lanes)
+        tabs = jax.tree.map(
+            jnp.asarray,
+            stm.encode_batch(scheds, genv.bound_nodes, 2),
+        )
+        roots = jnp.stack([prng.root_key(s) for s in range(n_lanes)])
+        from tpu_paxos.config import EdgeFaultConfig as _E
+
+        # one scalar mix + one TRUE-geometry WAN matrix: both pad to
+        # [lanes, A_bound, A_bound] — the padded envelope's one program
+        mixes = [cfg.faults, FaultConfig(
+            max_delay=2,
+            edges=_E.uniform(cfg.n_nodes, dup_rate=1000, max_delay=1),
+        )]
+        kn, _ = runner._knob_arrays(
+            n_lanes, [mixes[i % 2] for i in range(n_lanes)]
+        )
+        owner_cfg = dc.replace(
+            runner.cfg, n_nodes=cfg.n_nodes, proposers=cfg.proposers
+        )
+        pend, gate, tail, exp, own, _ = runner._queues(
+            n_lanes, [(workload, None)] * n_lanes, owner_cfg=owner_cfg
+        )
+        gm = geo.geometry_for(genv, cfg.n_nodes, cfg.proposers)
+        pkn = geo.protocol_knobs(
+            cfg.protocol, stall_patience=simm.IDLE_RESTART_ROUNDS
+        )
+
+        def _bl(x):
+            x = np.asarray(x)
+            return jnp.asarray(
+                np.broadcast_to(x, (n_lanes,) + x.shape)
+            )
+
+        gm_l = jax.tree.map(_bl, gm)
+        pkn_l = jax.tree.map(_bl, pkn)
+        states = runner._init(
+            jnp.asarray(pend), jnp.asarray(gate), jnp.asarray(tail),
+            roots, gm_l, pkn_l,
+        )
+        args = (
+            roots, states, tabs, jax.tree.map(jnp.asarray, kn),
+            jnp.asarray(exp), jnp.asarray(own), gm_l, pkn_l,
+        )
+        return runner._fn, args
+
     def shard_build(mesh):
         # 8 lanes tile the whole {1, 2, 4, 8} grid; the lane program
         # is the mesh=None one — only the tiling changes
@@ -693,6 +895,64 @@ def audit_entries():
         ]
         return {"verdicts": verdicts, "lane_logs": logs}
 
+    def shard_build_envelope(mesh):
+        return _build_envelope(mesh=mesh, n_lanes=8)
+
+    def shard_state_envelope():
+        # the [lanes]-stacked PADDED SimState: every bound-shaped leaf
+        # must still match the committed fleet partition rules
+        _fn, args = _build_envelope()
+        return "fleet", args[1]
+
+    def shard_parity_envelope(n_devices: int):
+        """SH304, padded twin: one 3-in-5 dispatch per mesh shape —
+        verdict nibbles + decision-log sha256 bitwise mesh-invariant
+        THROUGH the geometry padding (the menu-switched draws must
+        stay lane-local under the tiling)."""
+        import hashlib
+
+        from tpu_paxos.parallel import mesh as pmesh
+        from tpu_paxos.replay.decision_log import decision_log
+
+        mesh = (
+            pmesh.make_instance_mesh(n_devices) if n_devices > 1 else None
+        )
+        cfg = _audit_cfg()
+        genv = _genv()
+        workload = simm.default_workload(cfg)
+        runner = FleetRunner(
+            genv.bound_cfg(cfg), workload, max_episodes=2,
+            geometry=genv, mesh=mesh,
+        )
+        rep = runner.run(
+            list(range(8)), _audit_scheds(8),
+            workloads=[(workload, None)] * 8,
+            knobs=[cfg.faults] * 8,
+            geometry=(cfg.n_nodes, cfg.proposers),
+        )
+        v = rep.verdict
+        verdicts = "".join(
+            format(
+                (int(bool(v.ok[i])) << 3)
+                | (int(bool(v.agreement[i])) << 2)
+                | (int(bool(v.coverage[i])) << 1)
+                | int(bool(v.quiescent[i])),
+                "x",
+            )
+            for i in range(rep.n_lanes)
+        )
+        met = rep.final.met
+        stride = runner.vid_bound
+        logs = [
+            hashlib.sha256(decision_log(
+                np.asarray(met.chosen_vid[i]),
+                np.asarray(met.chosen_ballot[i]),
+                stride, cfg.n_instances,
+            ).encode()).hexdigest()
+            for i in range(rep.n_lanes)
+        ]
+        return {"verdicts": verdicts, "lane_logs": logs}
+
     ir204_why = (
         "the vmapped lane body IS core/sim's round_fn — same "
         "unique-key compaction sorts as sim.run_rounds"
@@ -705,6 +965,18 @@ def audit_entries():
             shard_build=shard_build,
             shard_state=shard_state,
             shard_parity=shard_parity,
+        ),
+        AuditEntry(
+            # the geometry-padded twin: node/proposer axes at the menu
+            # bound, Geometry + ProtocolKnobs as trailing lane-stacked
+            # runtime inputs — the padding toll is pinned per
+            # primitive (op/hlo budgets) and the padded program
+            # certifies over the same {1, 2, 4, 8} mesh grid
+            "fleet.run_lanes_envelope", _build_envelope,
+            allow=("IR204",), why=ir204_why, hlo_golden=True,
+            shard_build=shard_build_envelope,
+            shard_state=shard_state_envelope,
+            shard_parity=shard_parity_envelope,
         ),
         AuditEntry(
             # the telemetry-armed twin: recorder accumulators (incl.

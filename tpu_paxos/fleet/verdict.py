@@ -81,6 +81,7 @@ def lane_verdict(
     expected: np.ndarray,
     owner_node: np.ndarray,
     vid_cap: int | None = None,
+    geom=None,
 ) -> LaneVerdict:
     """Judge one (unbatched) final engine state on device — the fleet
     runner vmaps this over the lane axis inside the same jit as the
@@ -114,8 +115,14 @@ def lane_verdict(
     covered = bitmap[jnp.clip(exp, 0, vid_cap - 1)]
     coverage = jnp.all(~valid | covered | owner_crashed)
 
-    pn = jnp.asarray(cfg.proposers, jnp.int32)
-    all_props_crashed = jnp.all(final.crashed[pn])
+    if geom is None:
+        pn = jnp.asarray(cfg.proposers, jnp.int32)
+        all_props_crashed = jnp.all(final.crashed[pn])
+    else:
+        # padded lanes: pad proposer slots gather node 0 through the
+        # pn 0-padding — count them as vacuously crashed so only TRUE
+        # proposers can excuse a non-quiescent lane
+        all_props_crashed = jnp.all(final.crashed[geom.pn] | ~geom.prop_mask)
     quiescent = final.done | all_props_crashed
 
     max_round = jnp.max(

@@ -96,6 +96,7 @@ import numpy as np
 
 from tpu_paxos.analysis import tracecount
 from tpu_paxos.core import ballot as bal
+from tpu_paxos.core import geom as geo
 from tpu_paxos.core import values as val
 from tpu_paxos.membership import churn_table as ctm
 from tpu_paxos.utils import prng
@@ -252,8 +253,21 @@ def _build_round(
     crash_rate: int = 0,
     comp=None,
     runtime_schedule: bool = False,
+    geometry=None,
 ):
-    """``comp`` is a compiled fault schedule (core/faults.py) or None;
+    """``geometry`` (core/geom.GeometryEnvelope) builds the
+    geometry-PADDED round: ``n`` must be the envelope's node bound and
+    the round takes a traced menu index (``round_fn(root, st, tab,
+    gidx)``), which dispatches the engine's two node-shaped PRNG draws
+    — the anti-dueling backoff and the i.i.d. crash coins — through
+    ``lax.switch`` branches at each entry's TRUE node count (threefry
+    bits are shape-dependent), bit-identical to the unpadded build.
+    The member engine is already runtime-membership everywhere else:
+    nodes beyond the true count never join a view, so every mask-
+    driven phase ignores them for free.  Requires
+    ``runtime_schedule=True`` (the padded engine is fleet data).
+
+    ``comp`` is a compiled fault schedule (core/faults.py) or None;
     with ``runtime_schedule=True`` the schedule instead arrives as a
     traced ``fleet/schedule_table.ScheduleTable`` argument (the
     round becomes ``round_fn(root, st, tab)``) and the per-round masks
@@ -272,6 +286,19 @@ def _build_round(
     cap's live-majority room accounts for them."""
     from tpu_paxos.fleet import schedule_table as stm
 
+    if geometry is not None:
+        if not runtime_schedule:
+            raise ValueError(
+                "a geometry-padded member round needs "
+                "runtime_schedule=True (the padded engine is fleet "
+                "data, not a compiled constant)"
+            )
+        if n != geometry.bound_nodes:
+            raise ValueError(
+                "a geometry-padded member round must be built at the "
+                f"envelope node bound ({geometry.bound_nodes}), got "
+                f"n={n}"
+            )
     idx = jnp.arange(i_cap, dtype=jnp.int32)
     rows = jnp.arange(n)
     horizon = comp.horizon if comp is not None else 0
@@ -285,7 +312,7 @@ def _build_round(
         jnp.asarray(comp.crashed) if comp is not None and comp.has_crash else None
     )
 
-    def _round_core(root, st: MemberState, tab) -> MemberState:
+    def _round_core(root, st: MemberState, tab, gidx=None) -> MemberState:
         t = st.t
         exist = ~st.crashed  # [N] not-crashed (excusals key off this)
         if runtime_schedule:
@@ -585,7 +612,14 @@ def _build_round(
         stale = outstanding & (batch_age >= ACCEPT_STALE_ROUNDS)
         prepared = prepared & ~stale
         kd = prng.stream(root, prng.STREAM_PREPARE_DELAY, t)
-        backoff = jax.random.randint(kd, (n,), 0, 4, dtype=jnp.int32)
+        if geometry is None:
+            backoff = jax.random.randint(kd, (n,), 0, 4, dtype=jnp.int32)
+        else:
+            # menu-switched draw at the TRUE node count (pad nodes
+            # never prepare, so their 0 backoff is never consulted)
+            backoff = geo.menu_randint(
+                geometry, gidx, kd, "nodes", 0, 4, pad_value=0
+            )
         delay_until = jnp.where(stale, t + 1 + backoff, st.delay_until)
         batch_age = jnp.where(stale, 0, batch_age)
 
@@ -859,7 +893,15 @@ def _build_round(
         # candidates — n is the node count, <= 32 by construction.
         if crash_rate:
             ku = prng.stream(root, prng.STREAM_CRASH, t)
-            u = jax.random.randint(ku, (n,), 0, 1_000_000)
+            if geometry is None:
+                u = jax.random.randint(ku, (n,), 0, 1_000_000)
+            else:
+                # pad coin 1_000_000 never crashes: the comparison
+                # below is strict `<` and crash_rate <= 1_000_000
+                u = geo.menu_randint(
+                    geometry, gidx, ku, "nodes", 0, 1_000_000,
+                    pad_value=1_000_000,
+                )
             # admission works over the not-crashed mask (`base`), NOT
             # the I/O-alive one: a paused node resumes, so it still
             # counts toward live majorities and must never be folded
@@ -911,7 +953,10 @@ def _build_round(
             chosen_ballot=chosen_ballot,
         )
 
-    if runtime_schedule:
+    if geometry is not None:
+        def round_fn(root, st: MemberState, tab, gidx) -> MemberState:
+            return _round_core(root, st, tab, gidx)
+    elif runtime_schedule:
         def round_fn(root, st: MemberState, tab) -> MemberState:
             return _round_core(root, st, tab)
     else:
@@ -962,13 +1007,17 @@ def applied_log_of(state: MemberState, node: int) -> np.ndarray:
     return col[(col >= 0) & (col < CHANGE_BASE)]
 
 
-def decision_log_of(state: MemberState) -> str:
+def decision_log_of(state: MemberState, n_nodes: int | None = None) -> str:
     """Canonical decision-log text — chosen (vid, round, ballot) per
     instance plus each node's applied log — the byte-compare surface
     for record-vs-replay AND for host-stepped-vs-device-resident
     driver parity (mirrors member/diff.sh diffing two runs' logs).
     The node count comes from the state itself, so a caller can never
-    truncate or over-read the applied[] lines."""
+    truncate or over-read the applied[] lines — except a
+    geometry-PADDED caller, which passes its TRUE ``n_nodes`` so the
+    log is byte-equal to the unpadded run's (pad nodes never exist;
+    emitting their empty applied[] rows would fork the format, not
+    the decisions)."""
     cv = np.asarray(state.chosen_vid)
     cr = np.asarray(state.chosen_round)
     cb = np.asarray(state.chosen_ballot)
@@ -976,7 +1025,8 @@ def decision_log_of(state: MemberState) -> str:
         f"[{i}] = <{cv[i]}>@{cr[i]}#{cb[i]}"
         for i in np.flatnonzero(cv != int(val.NONE))
     ]
-    for node in range(state.crashed.shape[0]):
+    n = state.crashed.shape[0] if n_nodes is None else int(n_nodes)
+    for node in range(n):
         seq = " ".join(map(str, applied_log_of(state, node).tolist()))
         lines.append(f"applied[{node}] = {seq}")
     return "\n".join(lines) + "\n"
@@ -1135,17 +1185,20 @@ def _check_churn_capacity(
 
 
 def _build_churn_loop(round_fn, c: int, max_rounds: int,
-                      runtime_tables: bool):
+                      runtime_tables: bool, padded: bool = False):
     """The whole-run churn loop — inject -> round -> run-complete? as
     one ``lax.while_loop`` — shared by ``ChurnEngine`` (single runs)
     and the fleet lane body (``fleet/member_runner.py`` vmaps it), so
     the two can never drift apart on termination or injection
     ordering.  Returns ``go(root, st, ctab, ftab) -> (final_state,
-    cursor, done)``; the round budget extends past the fault table's
-    (traced) horizon, the heal-then-converge contract."""
+    cursor, done)`` — with ``padded=True`` (a geometry-padded
+    ``round_fn``) the loop instead returns ``go(root, st, ctab, ftab,
+    gidx)`` and threads the traced menu index through every round.
+    The round budget extends past the fault table's (traced) horizon,
+    the heal-then-converge contract."""
     budget = jnp.int32(max_rounds)
 
-    def go(root, st: MemberState, ctab, ftab):
+    def go(root, st: MemberState, ctab, ftab, *gp):
         def cond(carry):
             s, _cur, done = carry
             return (~done) & (
@@ -1155,10 +1208,12 @@ def _build_churn_loop(round_fn, c: int, max_rounds: int,
         def body(carry):
             s, cur, _done = carry
             s, cur = _churn_inject(ctab, cur, s, c)
-            s = (
-                round_fn(root, s, ftab) if runtime_tables
-                else round_fn(root, s)
-            )
+            if padded:
+                s = round_fn(root, s, ftab, gp[0])
+            elif runtime_tables:
+                s = round_fn(root, s, ftab)
+            else:
+                s = round_fn(root, s)
             return s, cur, _churn_done(ctab, cur, s)
 
         return jax.lax.while_loop(

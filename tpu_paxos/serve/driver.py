@@ -101,13 +101,20 @@ def vid_bound_of(workload) -> int:
 def init_serve_state(
     cfg: SimConfig, workload, vid_bound: int, root,
     window_rounds: int = 0,
+    geometry=None, geom=None, pknobs=None,
 ) -> tuple[ServeLoopState, int]:
     """Fresh loop state for one serve run: empty queues, zeroed
     recorder (plus zeroed ``[W]`` window rings when ``window_rounds``
     is nonzero — must match the builder's), all-NONE ingest table.
+    Geometry-padded serving passes the builder's GeometryEnvelope plus
+    this tenant's traced ``geom``/``pknobs`` (core/geom) so the
+    initial backoff draw matches the true geometry bit for bit.
     Returns ``(state, queue_cap)``."""
     pend, gate, tail, c = empty_queues(cfg, workload)
-    st = simm.init_state(cfg, pend, gate, tail, root)
+    st = simm.init_state(
+        cfg, pend, gate, tail, root,
+        geometry=geometry, geom=geom, pknobs=pknobs,
+    )
     tele = telem.init_telemetry(
         cfg.n_instances, len(cfg.proposers), cfg.n_nodes
     )
@@ -123,6 +130,7 @@ def build_serve_window(
     vid_bound: int,
     rounds_per_window: int,
     window_rounds: int = 0,
+    geometry=None,
 ):
     """Compile-time closure for one serving envelope: the jitted
     ``serve_window(ss, root, admits, arrs) -> (ss, done, t, summary)``
@@ -143,7 +151,15 @@ def build_serve_window(
     dispatch hands the harness per-bucket p50/p99 as a STREAM — the
     call returns ``(ss, done, t, summary, window_summary)``.  The
     trajectory is identical either way (the recorder is read-only);
-    ``window_rounds=0`` traces the exact pre-windowing program."""
+    ``window_rounds=0`` traces the exact pre-windowing program.
+
+    ``geometry`` (core/geom.GeometryEnvelope) builds the
+    geometry-PADDED window: ``cfg`` must be the envelope's bound cfg
+    and the jitted surface becomes ``serve_window(ss, root, admits,
+    arrs, gm, pkn)`` — the tenant's true geometry and protocol knobs
+    are per-dispatch data, so ONE warm window serves every tenant
+    geometry on the menu (pad proposer rows of ``admits`` carry NONE
+    and admit nothing)."""
     if cfg.faults.schedule is not None:
         raise ValueError(
             "serve engines take no fault schedule (correlated-fault "
@@ -151,12 +167,14 @@ def build_serve_window(
         )
     ww = int(window_rounds)
     round_fn = simm.build_engine(
-        cfg, queue_cap, vid_cap=0, telemetry=True, window_rounds=ww
+        cfg, queue_cap, vid_cap=0, telemetry=True, window_rounds=ww,
+        geometry=geometry, runtime_protocol=geometry is not None,
     )
     r = int(rounds_per_window)
     v_bound = int(vid_bound)
 
-    def serve_window(ss, root, admits, arrs):
+    def serve_window(ss, root, admits, arrs, *gp):
+        gm, pkn = gp if gp else (None, None)
         s = admits.shape[0]
 
         def sub(i, carry):
@@ -173,7 +191,7 @@ def build_serve_window(
             st = simm.admit_block(st, admit)
 
             def body(_, c):
-                return round_fn(root, c[0], tele=c[1])
+                return round_fn(root, c[0], tele=c[1], geom=gm, pknobs=pkn)
 
             st, tl = jax.lax.fori_loop(0, r, body, (st, tl))
             return ServeLoopState(st, tl, ingest)
@@ -213,7 +231,7 @@ def clear_cache() -> None:
     _CACHE.clear()
 
 
-def engine_static_key(cfg: SimConfig) -> tuple:
+def engine_static_key(cfg: SimConfig, geometry=None) -> tuple:
     """THE compile-time facts of a serve engine build, as one hashable
     tuple — the single source of truth shared by :func:`window_for`'s
     cache key and the fleet serve envelope key
@@ -221,15 +239,25 @@ def engine_static_key(cfg: SimConfig) -> tuple:
     engine build MUST land here, or a changed config could HIT a warm
     cache and silently run the wrong executable (exactly how
     ``edges``/``delivery_cut`` were once missing from one of two
-    hand-duplicated lists)."""
+    hand-duplicated lists).
+
+    A ``geometry`` envelope COLLAPSES the key: the menu replaces the
+    per-geometry (n_nodes, proposers) facts and the protocol tuple
+    drops out (traced per dispatch) — one cache slot per bound, not
+    per tenant geometry."""
     return (
         simm.seeded_wedge(),
-        cfg.n_nodes,
-        cfg.proposers,
+        (
+            (cfg.n_nodes, cfg.proposers)
+            if geometry is None else ("geom", geometry.menu)
+        ),
         cfg.n_instances,
         cfg.assign_window,
         cfg.max_rounds,
-        dataclasses.astuple(cfg.protocol),
+        (
+            dataclasses.astuple(cfg.protocol)
+            if geometry is None else "runtime-protocol"
+        ),
         (
             cfg.faults.drop_rate, cfg.faults.dup_rate,
             cfg.faults.min_delay, cfg.faults.max_delay,
@@ -242,6 +270,7 @@ def engine_static_key(cfg: SimConfig) -> tuple:
 def window_for(
     cfg: SimConfig, queue_cap: int, vid_bound: int, rounds_per_window: int,
     window_rounds: int = 0,
+    geometry=None,
 ):
     """Envelope-keyed cache over :func:`build_serve_window` (the
     ``fleet/envelope.runner_for`` discipline): a knee sweep's rate
@@ -259,7 +288,7 @@ def window_for(
             "serving rides the fleet envelope, not this driver)"
         )
     key = (
-        engine_static_key(cfg),
+        engine_static_key(cfg, geometry=geometry),
         int(queue_cap),
         int(vid_bound),
         int(rounds_per_window),
@@ -269,7 +298,7 @@ def window_for(
     if fn is None:
         fn = build_serve_window(
             cfg, queue_cap, vid_bound, rounds_per_window,
-            window_rounds=window_rounds,
+            window_rounds=window_rounds, geometry=geometry,
         )
         _CACHE[key] = fn
     return fn
@@ -326,6 +355,58 @@ def audit_entries():
         fn, args = _setup()
         return fn, args, {}
 
+    def _setup_envelope():
+        # the geometry-padded window: same admission blocks, traced
+        # through the 5-node / 3-proposer bound with the TRUE (3, 2)
+        # geometry and the protocol knobs as trailing runtime inputs;
+        # the donated loop state is the PADDED one, so the aliasing
+        # checker accounts for every bound-shaped leaf
+        from tpu_paxos.core import geom as geo
+
+        genv = geo.GeometryEnvelope(menu=((3, (0, 1)), (5, (0, 1, 2))))
+        cfg = dataclasses.replace(
+            audit_canonical_cfg(),
+            faults=FaultConfig(drop_rate=500, crash_rate=1000, max_delay=2),
+        )
+        bcfg = genv.bound_cfg(cfg)
+        workload = simm.default_workload(cfg)
+        v_bound = vid_bound_of(workload)
+        root = prng.root_key(cfg.seed)
+        gm = geo.geometry_for(genv, cfg.n_nodes, cfg.proposers)
+        pkn = geo.protocol_knobs(
+            cfg.protocol, stall_patience=simm.IDLE_RESTART_ROUNDS
+        )
+        wl = workload + [np.zeros((0,), np.int32)]
+        ss, c = init_serve_state(
+            bcfg, wl, v_bound, root, window_rounds=w_rounds,
+            geometry=genv, geom=gm, pknobs=pkn,
+        )
+        fn = window_for(
+            bcfg, c, v_bound, r_window, window_rounds=w_rounds,
+            geometry=genv,
+        )
+        p = len(bcfg.proposers)
+        admits = np.full((s_windows, p, k_admit), int(val.NONE), np.int32)
+        arrs = np.zeros((s_windows, p, k_admit), np.int32)
+        for pi, w in enumerate(workload):
+            w = np.asarray(w, np.int32)
+            for si in range(s_windows):
+                blk = w[si * k_admit:(si + 1) * k_admit]
+                admits[si, pi, :len(blk)] = blk
+                arrs[si, pi, :len(blk)] = si * r_window
+        return fn, (
+            ss, root, jnp.asarray(admits), jnp.asarray(arrs),
+            jax.tree.map(jnp.asarray, gm),
+            jax.tree.map(jnp.asarray, pkn),
+        )
+
+    def build_envelope():
+        return _setup_envelope()
+
+    def hlo_build_envelope():
+        fn, args = _setup_envelope()
+        return fn, args, {}
+
     ir204_why = (
         "the window body IS core/sim's round_fn — same unique-key "
         "compaction sorts as sim.run_rounds"
@@ -337,6 +418,18 @@ def audit_entries():
             allow=("IR204",), why=ir204_why,
             donate_argnums=(0,),
             hlo_build=hlo_build,
+            hlo_golden=True,
+        ),
+        AuditEntry(
+            # the geometry-padded twin: one warm window executable per
+            # hardware bound, tenant geometry as runtime data — the
+            # donation contract must survive the padding (a dropped
+            # alias on the BOUND-shaped queue plane doubles the larger
+            # buffer)
+            "serve.window_envelope", build_envelope,
+            allow=("IR204",), why=ir204_why,
+            donate_argnums=(0,),
+            hlo_build=hlo_build_envelope,
             hlo_golden=True,
         ),
     ]
